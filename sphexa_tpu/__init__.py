@@ -1,0 +1,11 @@
+"""sphexa-tpu: a TPU-native smoothed-particle-hydrodynamics framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of SPH-EXA
+(C++/MPI/CUDA reference, see SURVEY.md): Hilbert-curve domain decomposition,
+cornerstone octrees, neighbor search, std/VE SPH pipelines, Barnes-Hut
+self-gravity, turbulence stirring, checkpoint/restart and the built-in test
+cases — all expressed as fixed-shape array programs that XLA can fuse, tile
+onto the VPU/MXU, and scale over a device mesh with ICI collectives.
+"""
+
+__version__ = "0.1.0"

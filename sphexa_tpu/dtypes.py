@@ -1,0 +1,23 @@
+"""Default dtype policy for the framework.
+
+The reference uses float64 coordinates + float32 hydro fields
+(sph/include/sph/types.hpp:39-46 in SPH-EXA). TPUs have no fast f64, so the
+TPU-native policy is:
+
+- SFC keys: uint32 (30-bit keys, 10 octree levels). The key space, not the
+  float coordinate, is the primary spatial ordering structure, mirroring the
+  reference's 63-bit Hilbert keys at reduced depth.
+- coordinates & hydro fields: float32.
+- reductions that guard conservation diagnostics: compensated/f64-on-host.
+"""
+
+import jax.numpy as jnp
+
+# Key type for space-filling-curve keys. 10 levels x 3 bits = 30 bits.
+KEY_DTYPE = jnp.uint32
+KEY_BITS = 10  # octree levels encodable in a key
+KEY_MAX = jnp.uint32((1 << (3 * KEY_BITS)))  # one past the largest key
+
+COORD_DTYPE = jnp.float32
+HYDRO_DTYPE = jnp.float32
+INDEX_DTYPE = jnp.int32

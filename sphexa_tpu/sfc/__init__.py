@@ -1,0 +1,25 @@
+"""Space-filling-curve keys, bounding boxes and periodic-boundary math.
+
+TPU-native equivalent of the reference's ``domain/include/cstone/sfc/``
+(hilbert.hpp, morton.hpp, sfc.hpp, box.hpp): pure integer bit arithmetic,
+fully vectorized over particle arrays, no per-particle control flow.
+"""
+
+from sphexa_tpu.sfc.box import Box, BoundaryType, apply_pbc, put_in_box, make_global_box
+from sphexa_tpu.sfc.morton import morton_encode, morton_decode
+from sphexa_tpu.sfc.hilbert import hilbert_encode, hilbert_decode
+from sphexa_tpu.sfc.keys import compute_sfc_keys, coords_to_igrid
+
+__all__ = [
+    "Box",
+    "BoundaryType",
+    "apply_pbc",
+    "put_in_box",
+    "make_global_box",
+    "morton_encode",
+    "morton_decode",
+    "hilbert_encode",
+    "hilbert_decode",
+    "compute_sfc_keys",
+    "coords_to_igrid",
+]

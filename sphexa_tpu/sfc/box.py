@@ -1,0 +1,100 @@
+"""Global bounding box and periodic-boundary-condition math.
+
+TPU-native equivalent of the reference's ``cstone/sfc/box.hpp`` (Box,
+BoundaryType, putInBox, applyPBC) and ``cstone/sfc/box_mpi.hpp``
+(makeGlobalBox). The box limits are traced jnp scalars so a growing open
+box does not trigger recompilation; the boundary *types* are static python
+ints because they select code paths.
+"""
+
+import dataclasses
+import enum
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BoundaryType(enum.IntEnum):
+    """Per-dimension boundary behavior (cstone/sfc/box.hpp BoundaryType)."""
+
+    open = 0
+    periodic = 1
+    fixed = 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Box:
+    """Axis-aligned global bounding box with per-dimension boundary types.
+
+    ``lo``/``hi`` are shape-(3,) arrays (traced, may change step to step for
+    open boundaries); ``boundaries`` is static metadata.
+    """
+
+    lo: jax.Array
+    hi: jax.Array
+    boundaries: Tuple[BoundaryType, BoundaryType, BoundaryType] = dataclasses.field(
+        metadata=dict(static=True),
+        default=(BoundaryType.open, BoundaryType.open, BoundaryType.open),
+    )
+
+    @staticmethod
+    def create(xmin, xmax, ymin=None, ymax=None, zmin=None, zmax=None,
+               boundary=BoundaryType.open) -> "Box":
+        """Create a box; cubic if only (xmin, xmax) given, like cstone::Box."""
+        if ymin is None:
+            ymin, ymax, zmin, zmax = xmin, xmax, xmin, xmax
+        if isinstance(boundary, BoundaryType):
+            boundary = (boundary, boundary, boundary)
+        lo = jnp.array([xmin, ymin, zmin], dtype=jnp.float32)
+        hi = jnp.array([xmax, ymax, zmax], dtype=jnp.float32)
+        return Box(lo=lo, hi=hi, boundaries=tuple(BoundaryType(b) for b in boundary))
+
+    @property
+    def lengths(self) -> jax.Array:
+        return self.hi - self.lo
+
+    @property
+    def periodic_mask(self) -> jnp.ndarray:
+        """Static (3,) bool array: which dims wrap around."""
+        return jnp.array([b == BoundaryType.periodic for b in self.boundaries])
+
+
+def apply_pbc(box: Box, dxyz: jax.Array) -> jax.Array:
+    """Fold coordinate *differences* into the minimum image.
+
+    ``dxyz``: (..., 3) separation vectors. Mirrors cstone applyPBC: only
+    periodic dimensions are folded.
+    """
+    L = box.lengths
+    folded = dxyz - L * jnp.round(dxyz / L)
+    return jnp.where(box.periodic_mask, folded, dxyz)
+
+
+def put_in_box(box: Box, xyz: jax.Array) -> jax.Array:
+    """Fold absolute positions back into the box along periodic dimensions."""
+    L = box.lengths
+    folded = box.lo + jnp.mod(xyz - box.lo, L)
+    return jnp.where(box.periodic_mask, folded, xyz)
+
+
+def make_global_box(x, y, z, prev: Box, pad_factor: float = 0.0) -> Box:
+    """Grow the box to fit all particles; never change periodic/fixed dims.
+
+    Equivalent of makeGlobalBox (cstone/sfc/box_mpi.hpp:26-120): open
+    dimensions expand to the particle extrema (optionally padded); periodic
+    and fixed dimensions keep their limits. Runs inside jit; in a sharded
+    program the min/max reductions become cross-device collectives
+    automatically.
+    """
+    lo_fit = jnp.stack([x.min(), y.min(), z.min()])
+    hi_fit = jnp.stack([x.max(), y.max(), z.max()])
+    if pad_factor:
+        pad = (hi_fit - lo_fit) * pad_factor
+        lo_fit = lo_fit - pad
+        hi_fit = hi_fit + pad
+    keep = jnp.array([b != BoundaryType.open for b in prev.boundaries])
+    lo = jnp.where(keep, prev.lo, jnp.minimum(prev.lo, lo_fit))
+    hi = jnp.where(keep, prev.hi, jnp.maximum(prev.hi, hi_fit))
+    return Box(lo=lo, hi=hi, boundaries=prev.boundaries)

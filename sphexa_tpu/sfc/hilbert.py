@@ -1,0 +1,91 @@
+"""3D Hilbert key codec on uint32 arrays (Skilling's transpose algorithm).
+
+Role-equivalent of the reference's ``cstone/sfc/hilbert.hpp`` (iHilbert /
+decodeHilbert): the Hilbert curve is the default spatial sort order because
+its locality is markedly better than Morton's, which shrinks halo surfaces
+and makes sort-order windows good neighbor-candidate predictors.
+
+This implementation vectorizes John Skilling's public-domain transpose
+algorithm ("Programming the Hilbert curve", AIP Conf. Proc. 707, 2004) over
+arbitrary batch shapes: the per-bit loop is unrolled at trace time (10
+iterations), each iteration a handful of elementwise XOR/AND/select ops —
+ideal VPU work, no data-dependent control flow.
+
+The produced curve is the canonical self-similar Hilbert curve, so keys are
+hierarchical: the top ``3*L`` bits of a key are the level-``L`` cell key,
+which cell-range lookups (searchsorted) rely on. This prefix property is
+asserted in tests/test_sfc.py.
+"""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
+from sphexa_tpu.sfc.morton import _compact_bits_3d, _spread_bits_3d
+
+
+def _axes_to_transpose(x0, x1, x2, bits):
+    """Map grid coords to Hilbert 'transpose' form (Skilling AxestoTranspose)."""
+    X = [x0.astype(KEY_DTYPE), x1.astype(KEY_DTYPE), x2.astype(KEY_DTYPE)]
+    # Inverse undo
+    q = 1 << (bits - 1)
+    while q > 1:
+        p = KEY_DTYPE(q - 1)
+        for i in range(3):
+            cond = (X[i] & KEY_DTYPE(q)) != 0
+            t = (X[0] ^ X[i]) & p
+            x0_new = jnp.where(cond, X[0] ^ p, X[0] ^ t)
+            xi_new = jnp.where(cond, X[i], X[i] ^ t)
+            X[0] = x0_new
+            if i != 0:
+                X[i] = xi_new
+        q >>= 1
+    # Gray encode
+    X[1] = X[1] ^ X[0]
+    X[2] = X[2] ^ X[1]
+    t = jnp.zeros_like(X[0])
+    q = 1 << (bits - 1)
+    while q > 1:
+        t = jnp.where((X[2] & KEY_DTYPE(q)) != 0, t ^ KEY_DTYPE(q - 1), t)
+        q >>= 1
+    return [X[0] ^ t, X[1] ^ t, X[2] ^ t]
+
+
+def _transpose_to_axes(x0, x1, x2, bits):
+    """Inverse of :func:`_axes_to_transpose` (Skilling TransposetoAxes)."""
+    X = [x0.astype(KEY_DTYPE), x1.astype(KEY_DTYPE), x2.astype(KEY_DTYPE)]
+    # Gray decode by H ^ (H/2)
+    t = X[2] >> 1
+    X[2] = X[2] ^ X[1]
+    X[1] = X[1] ^ X[0]
+    X[0] = X[0] ^ t
+    # Undo excess work
+    q = 2
+    while q != (1 << bits):
+        p = KEY_DTYPE(q - 1)
+        for i in (2, 1, 0):
+            cond = (X[i] & KEY_DTYPE(q)) != 0
+            t = (X[0] ^ X[i]) & p
+            x0_new = jnp.where(cond, X[0] ^ p, X[0] ^ t)
+            xi_new = jnp.where(cond, X[i], X[i] ^ t)
+            X[0] = x0_new
+            if i != 0:
+                X[i] = xi_new
+        q <<= 1
+    return X
+
+
+def hilbert_encode(ix, iy, iz, bits: int = KEY_BITS):
+    """Encode integer grid coordinates in ``[0, 2**bits)`` into Hilbert keys."""
+    x0, x1, x2 = _axes_to_transpose(ix, iy, iz, bits)
+    # transpose form -> key: bit q of (x0, x1, x2) -> key bits (3q+2, 3q+1, 3q)
+    return (_spread_bits_3d(x0) << 2) | (_spread_bits_3d(x1) << 1) | _spread_bits_3d(x2)
+
+
+def hilbert_decode(key, bits: int = KEY_BITS):
+    """Decode Hilbert keys back into (ix, iy, iz) grid coordinates."""
+    key = key.astype(KEY_DTYPE)
+    x0 = _compact_bits_3d(key >> 2)
+    x1 = _compact_bits_3d(key >> 1)
+    x2 = _compact_bits_3d(key)
+    X = _transpose_to_axes(x0, x1, x2, bits)
+    return X[0], X[1], X[2]

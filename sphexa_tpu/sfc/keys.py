@@ -1,0 +1,33 @@
+"""Float coordinates -> integer grid -> SFC keys.
+
+Equivalent of the reference's ``cstone/sfc/sfc.hpp`` (computeSfcKeys /
+sfc3D): normalize positions by the global box into the integer key grid,
+then encode with the chosen curve. Default curve is Hilbert, matching the
+reference's ``SfcKind = HilbertKey`` default (sfc.hpp:53-55).
+"""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
+from sphexa_tpu.sfc.box import Box
+from sphexa_tpu.sfc.hilbert import hilbert_encode
+from sphexa_tpu.sfc.morton import morton_encode
+
+
+def coords_to_igrid(v, vmin, vmax, bits: int = KEY_BITS):
+    """Map float coordinates in [vmin, vmax] to integers in [0, 2**bits)."""
+    n = 1 << bits
+    scaled = (v - vmin) / (vmax - vmin) * n
+    return jnp.clip(scaled.astype(jnp.int32), 0, n - 1).astype(KEY_DTYPE)
+
+
+def compute_sfc_keys(x, y, z, box: Box, bits: int = KEY_BITS, curve: str = "hilbert"):
+    """Compute SFC keys for particle positions under the global box."""
+    ix = coords_to_igrid(x, box.lo[0], box.hi[0], bits)
+    iy = coords_to_igrid(y, box.lo[1], box.hi[1], bits)
+    iz = coords_to_igrid(z, box.lo[2], box.hi[2], bits)
+    if curve == "hilbert":
+        return hilbert_encode(ix, iy, iz, bits)
+    elif curve == "morton":
+        return morton_encode(ix, iy, iz, bits)
+    raise ValueError(f"unknown curve {curve!r}")

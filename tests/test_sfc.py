@@ -1,0 +1,148 @@
+"""SFC codec tests, mirroring the reference's unit/sfc/{morton,hilbert}.cpp:
+round-trip bijectivity, prefix (hierarchy) property, locality, and key order
+consistency with float coordinates.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sphexa_tpu.dtypes import KEY_BITS
+from sphexa_tpu.sfc import (
+    Box,
+    BoundaryType,
+    apply_pbc,
+    compute_sfc_keys,
+    hilbert_decode,
+    hilbert_encode,
+    make_global_box,
+    morton_decode,
+    morton_encode,
+    put_in_box,
+)
+
+
+def random_coords(rng, n, bits=KEY_BITS):
+    return [jnp.asarray(rng.integers(0, 1 << bits, n, dtype=np.uint32)) for _ in range(3)]
+
+
+class TestMorton:
+    def test_known_values(self):
+        # x is the most significant dimension: (1,0,0) at the deepest level -> 4
+        assert int(morton_encode(jnp.uint32(1), jnp.uint32(0), jnp.uint32(0))) == 4
+        assert int(morton_encode(jnp.uint32(0), jnp.uint32(1), jnp.uint32(0))) == 2
+        assert int(morton_encode(jnp.uint32(0), jnp.uint32(0), jnp.uint32(1))) == 1
+        # top-level octant: high bit of each coordinate -> key octant digit
+        top = 1 << (KEY_BITS - 1)
+        key = morton_encode(jnp.uint32(top), jnp.uint32(top), jnp.uint32(top))
+        assert int(key) >> (3 * (KEY_BITS - 1)) == 7
+
+    def test_roundtrip(self, rng):
+        ix, iy, iz = random_coords(rng, 1000)
+        jx, jy, jz = morton_decode(morton_encode(ix, iy, iz))
+        np.testing.assert_array_equal(np.asarray(jx), np.asarray(ix))
+        np.testing.assert_array_equal(np.asarray(jy), np.asarray(iy))
+        np.testing.assert_array_equal(np.asarray(jz), np.asarray(iz))
+
+    def test_prefix_property(self, rng):
+        ix, iy, iz = random_coords(rng, 500)
+        full = morton_encode(ix, iy, iz)
+        for level in (1, 3, 7):
+            shift = KEY_BITS - level
+            coarse = morton_encode(ix >> shift, iy >> shift, iz >> shift, bits=level)
+            np.testing.assert_array_equal(
+                np.asarray(full >> jnp.uint32(3 * shift)), np.asarray(coarse)
+            )
+
+
+class TestHilbert:
+    def test_roundtrip(self, rng):
+        ix, iy, iz = random_coords(rng, 1000)
+        jx, jy, jz = hilbert_decode(hilbert_encode(ix, iy, iz))
+        np.testing.assert_array_equal(np.asarray(jx), np.asarray(ix))
+        np.testing.assert_array_equal(np.asarray(jy), np.asarray(iy))
+        np.testing.assert_array_equal(np.asarray(jz), np.asarray(iz))
+
+    def test_bijective_small(self):
+        # exhaustive check at 2 levels: all 64 cells map to 64 distinct keys
+        g = np.arange(4, dtype=np.uint32)
+        ix, iy, iz = np.meshgrid(g, g, g, indexing="ij")
+        keys = hilbert_encode(
+            jnp.asarray(ix.ravel()), jnp.asarray(iy.ravel()), jnp.asarray(iz.ravel()), bits=2
+        )
+        assert len(np.unique(np.asarray(keys))) == 64
+        assert int(jnp.max(keys)) == 63
+
+    def test_continuity(self):
+        # consecutive keys decode to adjacent cells (the defining Hilbert property)
+        bits = 4
+        keys = jnp.arange(1 << (3 * bits), dtype=jnp.uint32)
+        x, y, z = hilbert_decode(keys, bits=bits)
+        coords = np.stack([np.asarray(x), np.asarray(y), np.asarray(z)], axis=1).astype(np.int64)
+        steps = np.abs(np.diff(coords, axis=0)).sum(axis=1)
+        np.testing.assert_array_equal(steps, np.ones(len(steps)))
+
+    def test_prefix_property(self, rng):
+        """Top 3L bits of a deep key == level-L key of the containing cell.
+
+        The neighbor-search cell-range lookup depends on this hierarchy.
+        """
+        ix, iy, iz = random_coords(rng, 500)
+        full = hilbert_encode(ix, iy, iz)
+        for level in (1, 2, 5, 9):
+            shift = KEY_BITS - level
+            coarse = hilbert_encode(ix >> shift, iy >> shift, iz >> shift, bits=level)
+            np.testing.assert_array_equal(
+                np.asarray(full >> jnp.uint32(3 * shift)), np.asarray(coarse)
+            )
+
+
+class TestKeys:
+    def test_sfc_key_ordering_matches_grid(self, rng):
+        box = Box.create(-1.0, 1.0, boundary=BoundaryType.periodic)
+        x = jnp.asarray(rng.uniform(-1, 1, 200), dtype=jnp.float32)
+        y = jnp.asarray(rng.uniform(-1, 1, 200), dtype=jnp.float32)
+        z = jnp.asarray(rng.uniform(-1, 1, 200), dtype=jnp.float32)
+        keys_h = compute_sfc_keys(x, y, z, box)
+        keys_m = compute_sfc_keys(x, y, z, box, curve="morton")
+        assert int(keys_h.max()) < (1 << 30)
+        # same grid cell <=> same key under either curve
+        same_h = np.asarray(keys_h)[:, None] == np.asarray(keys_h)[None, :]
+        same_m = np.asarray(keys_m)[:, None] == np.asarray(keys_m)[None, :]
+        np.testing.assert_array_equal(same_h, same_m)
+
+
+class TestBox:
+    def test_apply_pbc(self):
+        box = Box.create(0.0, 1.0, boundary=BoundaryType.periodic)
+        d = jnp.array([[0.9, -0.9, 0.4]])
+        folded = apply_pbc(box, d)
+        np.testing.assert_allclose(np.asarray(folded), [[-0.1, 0.1, 0.4]], atol=1e-6)
+
+    def test_apply_pbc_mixed(self):
+        box = Box.create(
+            0.0, 1.0, 0.0, 1.0, 0.0, 1.0,
+            boundary=(BoundaryType.periodic, BoundaryType.open, BoundaryType.open),
+        )
+        d = jnp.array([[0.9, 0.9, 0.9]])
+        folded = apply_pbc(box, d)
+        np.testing.assert_allclose(np.asarray(folded), [[-0.1, 0.9, 0.9]], atol=1e-6)
+
+    def test_put_in_box(self):
+        box = Box.create(-0.5, 0.5, boundary=BoundaryType.periodic)
+        p = jnp.array([[0.6, -0.7, 0.0]])
+        np.testing.assert_allclose(
+            np.asarray(put_in_box(box, p)), [[-0.4, 0.3, 0.0]], atol=1e-6
+        )
+
+    def test_make_global_box_grows_open_only(self):
+        prev = Box.create(
+            -1.0, 1.0, -1.0, 1.0, -1.0, 1.0,
+            boundary=(BoundaryType.periodic, BoundaryType.open, BoundaryType.open),
+        )
+        x = jnp.array([-3.0, 2.0])
+        y = jnp.array([-2.0, 0.5])
+        z = jnp.array([0.0, 0.1])
+        box = make_global_box(x, y, z, prev)
+        np.testing.assert_allclose(np.asarray(box.lo), [-1.0, -2.0, -1.0])
+        np.testing.assert_allclose(np.asarray(box.hi), [1.0, 1.0, 1.0])
