@@ -1,0 +1,11 @@
+"""Initial conditions for the built-in test cases.
+
+Counterpart of the reference's ``main/src/init/``: each case is a settings
+dict + coordinate generation + field initialization, producing a
+ParticleState, a Box, and SimConstants.
+"""
+
+from sphexa_tpu.init.grid import regular_grid
+from sphexa_tpu.init.sedov import init_sedov, sedov_constants
+
+__all__ = ["regular_grid", "init_sedov", "sedov_constants"]

@@ -1,0 +1,16 @@
+"""Lattice coordinate generators (counterpart of main/src/init/grid.hpp)."""
+
+import numpy as np
+
+
+def regular_grid(r: float, side: int):
+    """Regular cubic lattice centered on the origin, spanning [-r, r)^3.
+
+    Same layout as the reference's regularGrid (grid.hpp:90-130): spacing
+    2r/side with a half-step inset so the lattice tiles periodically.
+    Returns float32 (x, y, z) of length side**3.
+    """
+    step = 2.0 * r / side
+    line = (-r + 0.5 * step + step * np.arange(side)).astype(np.float32)
+    z, y, x = np.meshgrid(line, line, line, indexing="ij")
+    return x.ravel(), y.ravel(), z.ravel()

@@ -1,0 +1,25 @@
+"""Neighbor search: SFC-sorted cell-list gather with bounded candidate sets.
+
+TPU-native replacement for BOTH of the reference's neighbor paths — the CPU
+per-particle octree traversal (cstone/findneighbors.hpp:96-172) and the GPU
+warp-centric breadth-first traversal (cstone/traversal/find_neighbors.cuh):
+instead of tree walks, particles are sorted by SFC key, a uniform cell grid
+at a chosen octree level is addressed through searchsorted on the key
+array, and each particle gathers a fixed-size masked candidate set from its
+27-cell stencil. Everything is static-shape, fully vectorized, and fuses
+into a handful of XLA gather/reduce kernels.
+"""
+
+from sphexa_tpu.neighbors.cell_list import (
+    NeighborConfig,
+    choose_grid_level,
+    estimate_cell_cap,
+    find_neighbors,
+)
+
+__all__ = [
+    "NeighborConfig",
+    "choose_grid_level",
+    "estimate_cell_cap",
+    "find_neighbors",
+]

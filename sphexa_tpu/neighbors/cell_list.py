@@ -1,0 +1,185 @@
+"""Cell-list neighbor search over SFC-sorted particle arrays.
+
+Design (SURVEY.md §7 'cell-list/gather formulation'):
+
+1. Particles arrive sorted by SFC key (the global sort order everything in
+   the framework shares). A uniform grid at octree level ``L`` is implied by
+   the key hierarchy: the level-``L`` cell of a particle is the top ``3L``
+   bits of its key — so cell membership ranges in the sorted array are two
+   ``searchsorted`` calls, no bucket data structure at all.
+2. Each particle turns its 27-cell stencil into 27 contiguous index ranges
+   and gathers up to ``cap`` candidates per cell (masked beyond the actual
+   occupancy).
+3. Candidates are filtered by ``|r_ij| < 2 h_i`` and the closest ``ngmax``
+   are kept (matching the reference's ngmax truncation semantics,
+   findneighbors.hpp:96-172).
+
+Correctness requires the cell edge >= the search radius ``2*h`` in every
+dimension (choose_grid_level guarantees it at config time) and cell
+occupancy <= cap (estimate_cell_cap + the returned max_occupancy
+diagnostic guard it).
+
+All shapes are static: (N, ngmax) neighbor indices + mask. The search is
+chunked over particle blocks with lax.map to bound the transient
+(B, 27*cap) gather memory.
+"""
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
+from sphexa_tpu.sfc.box import Box, apply_pbc_xyz
+from sphexa_tpu.sfc.hilbert import hilbert_encode
+from sphexa_tpu.sfc.keys import coords_to_igrid
+from sphexa_tpu.sfc.morton import morton_encode
+
+
+@dataclasses.dataclass(frozen=True)
+class NeighborConfig:
+    """Static configuration of the neighbor search (hashable, jit-safe)."""
+
+    level: int  # octree level of the cell grid
+    cap: int  # max particles gathered per cell
+    ngmax: int = 150  # max neighbors kept per particle (reference ngmax)
+    block: int = 2048  # particles per lax.map block
+    curve: str = "hilbert"
+
+    @property
+    def num_candidates(self) -> int:
+        return 27 * self.cap
+
+
+def choose_grid_level(box_lengths, h_max: float) -> int:
+    """Deepest grid level whose cell edge still covers the 2h search radius.
+
+    Stands in for the reference's adaptive tree traversal: with cell edge
+    >= 2*h_max, the 27-stencil is guaranteed to cover every interaction
+    sphere.
+    """
+    min_extent = float(np.min(np.asarray(box_lengths)))
+    if h_max <= 0:
+        return KEY_BITS
+    level = int(np.floor(np.log2(min_extent / (2.0 * h_max))))
+    return max(1, min(KEY_BITS, level))
+
+
+def estimate_cell_cap(keys, level: int, margin: float = 1.3, quantum: int = 8) -> int:
+    """Max level-``level`` cell occupancy of ``keys``, padded with slack.
+
+    Host-side helper run at (re)configuration time. The margin absorbs
+    particle motion between reconfigurations; the quantum rounds up so small
+    occupancy drifts do not change the static cap (and thus do not
+    recompile).
+    """
+    shift = 3 * (KEY_BITS - level)
+    cells = np.asarray(keys, dtype=np.uint64) >> np.uint64(shift)
+    occ = int(np.bincount(cells.astype(np.int64)).max()) if len(cells) else 1
+    padded = int(np.ceil(occ * margin / quantum) * quantum)
+    return max(quantum, padded)
+
+
+@functools.lru_cache(maxsize=None)
+def _stencil(ncell: int) -> np.ndarray:
+    """Stencil offsets, deduplicated for coarse grids.
+
+    On a grid with fewer than 3 cells per dimension the -1/+1 offsets alias
+    the same cell (mod ncell); emitting both would double-count candidates.
+    """
+    per_dim = (-1, 0, 1) if ncell >= 3 else ((0, 1) if ncell == 2 else (0,))
+    return np.array(
+        [(dx, dy, dz) for dx in per_dim for dy in per_dim for dz in per_dim],
+        dtype=np.int32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def find_neighbors(
+    x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Neighbor lists for all particles.
+
+    Arguments are the SFC-sorted particle arrays and their keys. Returns:
+
+    - ``nidx`` (N, ngmax) int32: neighbor indices, closest-first; invalid
+      slots hold the particle's own index (safe to gather, must be masked);
+    - ``nmask`` (N, ngmax) bool: validity of each slot;
+    - ``nc`` (N,) int32: true neighbor count within 2h (excluding self, may
+      exceed ngmax — used by the smoothing-length update like the
+      reference's nc field);
+    - ``max_occupancy`` () int32: densest cell seen; if > cfg.cap the cap
+      must be raised and the search re-run (overflow diagnostic standing in
+      for the reference's GPU stack-overflow detection).
+    """
+    n = x.shape[0]
+    level = cfg.level
+    shift = KEY_DTYPE(3 * (KEY_BITS - level))
+    ncell = 1 << level
+    encode = hilbert_encode if cfg.curve == "hilbert" else morton_encode
+
+    ix = coords_to_igrid(x, box.lo[0], box.hi[0], level).astype(jnp.int32)
+    iy = coords_to_igrid(y, box.lo[1], box.hi[1], level).astype(jnp.int32)
+    iz = coords_to_igrid(z, box.lo[2], box.hi[2], level).astype(jnp.int32)
+
+    periodic = box.periodic_mask
+    stencil = jnp.asarray(_stencil(ncell))  # (<=27, 3)
+
+    num_blocks = -(-n // cfg.block)
+    pad = num_blocks * cfg.block - n
+    idx_blocks = jnp.arange(num_blocks * cfg.block, dtype=jnp.int32).reshape(
+        num_blocks, cfg.block
+    )
+
+    def process_block(idx):
+        idx = jnp.minimum(idx, n - 1)  # padded tail re-processes the last row
+        ci = jnp.stack([ix[idx], iy[idx], iz[idx]], axis=-1)  # (B, 3)
+        cells = ci[:, None, :] + stencil[None, :, :]  # (B, 27, 3)
+        wrapped = jnp.mod(cells, ncell)
+        in_range = (cells >= 0) & (cells < ncell)
+        cell_ok = jnp.all(in_range | periodic[None, None, :], axis=-1)  # (B, 27)
+        cells = jnp.where(periodic[None, None, :], wrapped, jnp.clip(cells, 0, ncell - 1))
+
+        ckey = encode(
+            cells[..., 0].astype(KEY_DTYPE),
+            cells[..., 1].astype(KEY_DTYPE),
+            cells[..., 2].astype(KEY_DTYPE),
+            bits=level,
+        )
+        start = jnp.searchsorted(sorted_keys, ckey << shift).astype(jnp.int32)
+        end = jnp.searchsorted(sorted_keys, (ckey + KEY_DTYPE(1)) << shift).astype(jnp.int32)
+        occupancy = jnp.max(end - start)
+
+        cand = start[..., None] + jnp.arange(cfg.cap, dtype=jnp.int32)  # (B,27,cap)
+        cand_ok = (cand < end[..., None]) & cell_ok[..., None]
+        cand = jnp.clip(cand, 0, n - 1).reshape(idx.shape[0], -1)
+        cand_ok = cand_ok.reshape(idx.shape[0], -1)
+
+        dx, dy, dz = apply_pbc_xyz(
+            box,
+            x[idx][:, None] - x[cand],
+            y[idx][:, None] - y[cand],
+            z[idx][:, None] - z[cand],
+        )
+        d2 = dx * dx + dy * dy + dz * dz
+
+        radius = 2.0 * h[idx]
+        hit = cand_ok & (d2 < (radius * radius)[:, None]) & (cand != idx[:, None])
+        nc = jnp.sum(hit, axis=-1).astype(jnp.int32)
+
+        score = jnp.where(hit, -d2, -jnp.inf)
+        top_score, top_pos = jax.lax.top_k(score, cfg.ngmax)
+        nidx = jnp.take_along_axis(cand, top_pos, axis=1)
+        nmask = top_score > -jnp.inf
+        nidx = jnp.where(nmask, nidx, idx[:, None])
+        return nidx, nmask, nc, occupancy
+
+    nidx, nmask, nc, occ = jax.lax.map(process_block, idx_blocks)
+    nidx = nidx.reshape(-1, cfg.ngmax)[:n]
+    nmask = nmask.reshape(-1, cfg.ngmax)[:n]
+    nc = nc.reshape(-1)[:n]
+    del pad
+    return nidx, nmask, nc, jnp.max(occ)

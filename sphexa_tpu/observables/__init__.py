@@ -1,0 +1,3 @@
+from sphexa_tpu.observables.conserved import conserved_quantities
+
+__all__ = ["conserved_quantities"]

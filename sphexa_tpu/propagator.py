@@ -1,0 +1,109 @@
+"""Propagators: the per-step orchestration of SPH ops.
+
+TPU-native counterpart of the reference's ``main/src/propagator/``
+(ipropagator.hpp, std_hydro.hpp, ve_hydro.hpp): a propagator owns the
+sequence of kernel calls for one time step. Where the reference interleaves
+MPI halo exchanges between kernels, the jitted step here operates on the
+full (sharded) arrays and XLA materializes whatever communication the
+shardings imply; the host never orchestrates communication.
+
+The whole step — SFC sort, neighbor search, hydro pipeline, time step,
+integration — is ONE jitted function of the ParticleState pytree, so XLA
+sees the complete dataflow and can fuse/schedule across op boundaries.
+"""
+
+import dataclasses
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.neighbors.cell_list import NeighborConfig, find_neighbors
+from sphexa_tpu.sfc.box import Box, make_global_box
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+from sphexa_tpu.sph import hydro_std
+from sphexa_tpu.sph.kernels import update_h
+from sphexa_tpu.sph.particles import ParticleState, SimConstants
+from sphexa_tpu.sph.positions import compute_positions
+from sphexa_tpu.sph.timestep import compute_timestep
+
+
+@dataclasses.dataclass(frozen=True)
+class PropagatorConfig:
+    """Static per-run configuration: physics constants + neighbor search."""
+
+    const: SimConstants
+    nbr: NeighborConfig
+    curve: str = "hilbert"
+    block: int = 2048
+
+
+def _sort_by_keys(state: ParticleState, box: Box, curve: str):
+    """Global SFC sort: the analog of domain.sync()'s keygen + radix sort
+    (cstone/domain/assignment.hpp:84-122). Every field array is gathered
+    into key order; scalars pass through untouched.
+    """
+    keys = compute_sfc_keys(state.x, state.y, state.z, box, curve=curve)
+    order = jnp.argsort(keys)
+    sorted_keys = keys[order]
+
+    def maybe_gather(leaf):
+        return leaf[order] if leaf.ndim == 1 and leaf.shape[0] == state.n else leaf
+
+    return jax.tree.map(maybe_gather, state), sorted_keys
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def step_hydro_std(
+    state: ParticleState, box: Box, cfg: PropagatorConfig
+) -> Tuple[ParticleState, Dict[str, jax.Array]]:
+    """One standard-SPH time step (std_hydro.hpp:123-175 sequence).
+
+    box regrow -> sort -> neighbors -> density -> EOS -> IAD ->
+    momentum/energy -> timestep -> positions -> smoothing-length update.
+    Returns (new_state, new_box, diagnostics).
+    """
+    const = cfg.const
+    # grow open-boundary dims to fit drifted particles (box_mpi.hpp role);
+    # box limits are traced values, so this never recompiles
+    box = make_global_box(state.x, state.y, state.z, box)
+    state, keys = _sort_by_keys(state, box, cfg.curve)
+    x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
+
+    nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
+
+    rho = hydro_std.compute_density(x, y, z, h, m, nidx, nmask, box, const, cfg.block)
+    p, c = hydro_std.compute_eos_std(state.temp, rho, const)
+    c11, c12, c13, c22, c23, c33 = hydro_std.compute_iad(
+        x, y, z, h, m / rho, nidx, nmask, box, const, cfg.block
+    )
+    ax, ay, az, du, dt_courant = hydro_std.compute_momentum_energy_std(
+        x, y, z, state.vx, state.vy, state.vz, h, m, rho, p, c,
+        c11, c12, c13, c22, c23, c33, nidx, nmask, box, const, cfg.block,
+    )
+
+    dt = compute_timestep(state.min_dt, dt_courant, const=const)
+
+    fields = (x, y, z, state.x_m1, state.y_m1, state.z_m1,
+              state.vx, state.vy, state.vz, h, state.temp, du, state.du_m1)
+    (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, du, du_m1) = compute_positions(
+        fields, ax, ay, az, dt, state.min_dt, box, const
+    )
+
+    new_h = update_h(const.ng0, nc + 1, h)
+
+    new_state = dataclasses.replace(
+        state,
+        x=nx, y=ny, z=nz, x_m1=dxm, y_m1=dym, z_m1=dzm,
+        vx=vx, vy=vy, vz=vz, h=new_h, temp=temp, du=du, du_m1=du_m1,
+        ttot=state.ttot + dt, min_dt=dt, min_dt_m1=state.min_dt,
+    )
+    diagnostics = {
+        "dt": dt,
+        "nc_mean": jnp.mean(nc.astype(jnp.float32)) + 1.0,
+        "nc_max": jnp.max(nc) + 1,
+        "occupancy": occ,
+        "rho_max": jnp.max(rho),
+    }
+    return new_state, box, diagnostics

@@ -72,6 +72,17 @@ def apply_pbc(box: Box, dxyz: jax.Array) -> jax.Array:
     return jnp.where(box.periodic_mask, folded, dxyz)
 
 
+def apply_pbc_xyz(box: Box, rx, ry, rz):
+    """Minimum-image fold of per-component separations (the form the
+    interaction kernels use; single source of truth with apply_pbc)."""
+    L = box.lengths
+    per = box.periodic_mask
+    rx = jnp.where(per[0], rx - L[0] * jnp.round(rx / L[0]), rx)
+    ry = jnp.where(per[1], ry - L[1] * jnp.round(ry / L[1]), ry)
+    rz = jnp.where(per[2], rz - L[2] * jnp.round(rz / L[2]), rz)
+    return rx, ry, rz
+
+
 def put_in_box(box: Box, xyz: jax.Array) -> jax.Array:
     """Fold absolute positions back into the box along periodic dimensions."""
     L = box.lengths

@@ -1,0 +1,28 @@
+"""SPH numerics: smoothing kernels, std and volume-element pipelines.
+
+TPU-native re-design of the reference's ``sph/include/sph/`` library: every
+kernel is a vectorized masked j-reduction over static-shape neighbor lists
+instead of a per-particle scalar loop; pipelines are pure functions over a
+ParticleState pytree.
+"""
+
+from sphexa_tpu.sph.kernels import (
+    artificial_viscosity,
+    kernel_norm_3d,
+    sinc_kernel,
+    sinc_kernel_derivative,
+    ts_k_courant,
+    update_h,
+)
+from sphexa_tpu.sph.particles import ParticleState, SimConstants
+
+__all__ = [
+    "artificial_viscosity",
+    "kernel_norm_3d",
+    "sinc_kernel",
+    "sinc_kernel_derivative",
+    "ts_k_courant",
+    "update_h",
+    "ParticleState",
+    "SimConstants",
+]

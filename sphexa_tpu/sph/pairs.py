@@ -1,0 +1,49 @@
+"""Shared pair-interaction geometry for the SPH j-reductions.
+
+Every SPH op is a masked reduction over a static-shape neighbor list
+(N, ngmax). This module holds the common block-level machinery: gather the
+j-side fields, compute minimum-image displacements, normalized kernel
+distances, and safe masked divisions.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from sphexa_tpu.sfc.box import Box, apply_pbc_xyz
+
+
+class PairGeom(NamedTuple):
+    idx: jnp.ndarray  # (B,) i-particle indices
+    nj: jnp.ndarray  # (B, ngmax) j-particle indices
+    mask: jnp.ndarray  # (B, ngmax) valid-pair mask
+    rx: jnp.ndarray  # (B, ngmax) minimum-image displacement x_i - x_j
+    ry: jnp.ndarray
+    rz: jnp.ndarray
+    dist: jnp.ndarray  # (B, ngmax) |r_ij|, 1 where masked (safe divisor)
+    v1: jnp.ndarray  # (B, ngmax) dist / h_i
+
+
+def pair_geometry(idx, x, y, z, h, nidx, nmask, box: Box) -> PairGeom:
+    """Gather the pair geometry for one particle block."""
+    nj = nidx[idx]
+    mask = nmask[idx]
+    rx = x[idx][:, None] - x[nj]
+    ry = y[idx][:, None] - y[nj]
+    rz = z[idx][:, None] - z[nj]
+    rx, ry, rz = apply_pbc_xyz(box, rx, ry, rz)
+    d2 = rx * rx + ry * ry + rz * rz
+    dist = jnp.sqrt(jnp.where(mask, d2, 1.0))
+    dist = jnp.where(mask, dist, 1.0)
+    v1 = dist / h[idx][:, None]
+    return PairGeom(idx, nj, mask, rx, ry, rz, dist, v1)
+
+
+def msum(mask, terms):
+    """Masked j-sum: zero out invalid pairs, reduce over the neighbor axis."""
+    return jnp.sum(jnp.where(mask, terms, 0.0), axis=-1)
+
+
+def mmax(mask, terms, init=0.0):
+    """Masked j-max with explicit identity."""
+    return jnp.max(jnp.where(mask, terms, init), axis=-1)

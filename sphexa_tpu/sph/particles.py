@@ -1,0 +1,125 @@
+"""Particle state pytree and simulation constants.
+
+TPU-native counterpart of the reference's ``sph/particles_data.hpp``: the
+SoA field registry becomes a dataclass-of-arrays pytree (so the whole state
+flows through jit/shard_map/checkpoint as one object), and the runtime
+constants (particles_data.hpp:89-138) become a static, hashable config that
+selects compiled code paths.
+
+Instead of the reference's acquire/release field aliasing (which caps live
+arrays by hand), transient fields (rho, c11.., divv, ...) are simply values
+inside the jitted step function — XLA's buffer liveness analysis reuses
+their memory automatically, which is the same optimization done by the
+compiler instead of by hand.
+"""
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.sph.kernels import kernel_norm_3d
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ParticleState:
+    """Conserved per-particle fields + integrator scalars.
+
+    Mirrors the reference's *conserved* field list (the set written to
+    checkpoints, propagator ConservedFields): positions, position deltas of
+    the previous step (x_m1 ... stored as deltas, positions.hpp:66-80),
+    velocities, smoothing length, mass, temperature, du_m1, AV alpha.
+    Dependent fields (rho, p, c, IAD tensors, ...) are recomputed every step
+    and live only inside the step function.
+    """
+
+    x: jax.Array
+    y: jax.Array
+    z: jax.Array
+    x_m1: jax.Array
+    y_m1: jax.Array
+    z_m1: jax.Array
+    vx: jax.Array
+    vy: jax.Array
+    vz: jax.Array
+    h: jax.Array
+    m: jax.Array
+    temp: jax.Array
+    du: jax.Array
+    du_m1: jax.Array
+    alpha: jax.Array
+    # integrator scalars (traced so steps don't recompile)
+    ttot: jax.Array
+    min_dt: jax.Array
+    min_dt_m1: jax.Array
+
+    @property
+    def n(self) -> int:
+        return self.x.shape[0]
+
+    @staticmethod
+    def zeros(n: int, dtype=jnp.float32) -> "ParticleState":
+        f = lambda: jnp.zeros(n, dtype)
+        s = lambda v: jnp.asarray(v, dtype)
+        return ParticleState(
+            x=f(), y=f(), z=f(), x_m1=f(), y_m1=f(), z_m1=f(),
+            vx=f(), vy=f(), vz=f(), h=f(), m=f(), temp=f(),
+            du=f(), du_m1=f(), alpha=f(),
+            ttot=s(0.0), min_dt=s(1e-12), min_dt_m1=s(1e-12),
+        )
+
+
+# universal gas constant in cgs, as used by the reference (sph/eos.hpp:16)
+R_GAS = 8.317e7
+
+
+def ideal_gas_cv(mui: float, gamma: float) -> float:
+    """Heat capacity for mean molecular weight mui (sph/eos.hpp:13-18)."""
+    return R_GAS / mui / (gamma - 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConstants:
+    """Static physics constants (particles_data.hpp:89-138 defaults)."""
+
+    ng0: int = 100
+    ngmax: int = 150
+    k_cour: float = 0.2
+    k_rho: float = 0.06
+    gamma: float = 5.0 / 3.0
+    mui: float = 10.0
+    alphamin: float = 0.05
+    alphamax: float = 1.0
+    decay_constant: float = 0.2
+    at_min: float = 0.1
+    at_max: float = 0.2
+    g: float = 0.0
+    eps: float = 0.005
+    eta_acc: float = 0.2
+    max_dt_increase: float = 1.1
+    sinc_index: float = 6.0
+    kernel_norm: Optional[float] = None  # filled by normalized()
+
+    @property
+    def ramp(self) -> float:
+        return 1.0 / (self.at_max - self.at_min)
+
+    @property
+    def cv(self) -> float:
+        return ideal_gas_cv(self.mui, self.gamma)
+
+    @property
+    def K(self) -> float:
+        if self.kernel_norm is None:
+            raise ValueError("use SimConstants.normalized() to fill kernel_norm")
+        return self.kernel_norm
+
+    def normalized(self) -> "SimConstants":
+        """Return a copy with the kernel normalization constant computed."""
+        if self.kernel_norm is not None:
+            return self
+        return dataclasses.replace(
+            self, kernel_norm=kernel_norm_3d(self.sinc_index)
+        )

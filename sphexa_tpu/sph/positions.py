@@ -1,0 +1,96 @@
+"""Time integration: 2nd-order Press position update + Adams-Bashforth energy.
+
+Physics-equivalent of the reference's ``sph/positions.hpp``: the previous
+step's position *deltas* (x_m1 ...) act as the velocity memory, the
+temperature is advanced from du/du_m1 with a 2nd-order Adams-Bashforth
+step, and particles in fixed-boundary skin layers stay frozen.
+"""
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from sphexa_tpu.sfc.box import BoundaryType, Box, put_in_box
+from sphexa_tpu.sph.particles import SimConstants
+
+
+def position_update(dt, dt_m1, x, y, z, ax, ay, az, dx_m1, dy_m1, dz_m1, box: Box):
+    """Press 2nd-order update (positions.hpp:66-80).
+
+    Returns new positions (PBC-folded), velocities, and the new deltas.
+    """
+    delta_a = dt + 0.5 * dt_m1
+    delta_b = 0.5 * (dt + dt_m1)
+    inv_dtm1 = 1.0 / dt_m1
+
+    valx, valy, valz = dx_m1 * inv_dtm1, dy_m1 * inv_dtm1, dz_m1 * inv_dtm1
+    vx = valx + ax * delta_a
+    vy = valy + ay * delta_a
+    vz = valz + az * delta_a
+    dx = dt * valx + ax * delta_b * dt
+    dy = dt * valy + ay * delta_b * dt
+    dz = dt * valz + az * delta_b * dt
+
+    pos = jnp.stack([x + dx, y + dy, z + dz], axis=-1)
+    pos = put_in_box(box, pos)
+    return pos[..., 0], pos[..., 1], pos[..., 2], vx, vy, vz, dx, dy, dz
+
+
+def fixed_boundary_frozen(x, y, z, h, vx, vy, vz, box: Box):
+    """Mask of particles frozen in fixed-boundary skin layers.
+
+    Mirrors fbcCheck + the v==0 condition of updatePositionsHost
+    (positions.hpp:46-101): stationary particles within 2h of a fixed wall
+    do not move.
+    """
+    stationary = (vx == 0.0) & (vy == 0.0) & (vz == 0.0)
+    frozen = jnp.zeros_like(stationary)
+    for dim, coord in enumerate((x, y, z)):
+        if box.boundaries[dim] == BoundaryType.fixed:
+            near = (jnp.abs(box.hi[dim] - coord) < 2.0 * h) | (
+                jnp.abs(coord - box.lo[dim]) < 2.0 * h
+            )
+            frozen = frozen | near
+    return stationary & frozen
+
+
+def energy_update(u_old, dt, dt_m1, du, du_m1):
+    """2nd-order Adams-Bashforth internal-energy step (positions.hpp:54-63).
+
+    The exponential fallback keeps u positive under strong cooling.
+    """
+    delta_a = 0.5 * dt * dt / dt_m1
+    delta_b = dt + delta_a
+    u_new = u_old + du * delta_b - du_m1 * delta_a
+    return jnp.where(
+        u_new < 0.0, u_old * jnp.exp(u_new * dt / jnp.maximum(u_old, 1e-30)), u_new
+    )
+
+
+def compute_positions(
+    state_fields: Tuple, ax, ay, az, dt, dt_m1, box: Box, const: SimConstants
+):
+    """Advance positions, velocities, and temperature for one step.
+
+    ``state_fields`` = (x, y, z, x_m1, y_m1, z_m1, vx, vy, vz, h, temp,
+    du, du_m1); returns the same tuple advanced. Equivalent of
+    computePositions + updateTempHost (positions.hpp:115-164).
+    """
+    x, y, z, x_m1, y_m1, z_m1, vx, vy, vz, h, temp, du, du_m1 = state_fields
+
+    frozen = fixed_boundary_frozen(x, y, z, h, vx, vy, vz, box)
+    nx, ny, nz, nvx, nvy, nvz, dx, dy, dz = position_update(
+        dt, dt_m1, x, y, z, ax, ay, az, x_m1, y_m1, z_m1, box
+    )
+    keep = lambda new, old: jnp.where(frozen, old, new)
+    nx, ny, nz = keep(nx, x), keep(ny, y), keep(nz, z)
+    nvx, nvy, nvz = keep(nvx, vx), keep(nvy, vy), keep(nvz, vz)
+    dx, dy, dz = keep(dx, x_m1), keep(dy, y_m1), keep(dz, z_m1)
+
+    cv = const.cv
+    u_old = cv * temp
+    u_new = energy_update(u_old, dt, dt_m1, du, du_m1)
+    n_temp = jnp.where(frozen, temp, u_new / cv)
+    n_du_m1 = jnp.where(frozen, du_m1, du)
+
+    return (nx, ny, nz, dx, dy, dz, nvx, nvy, nvz, h, n_temp, du, n_du_m1)

@@ -1,0 +1,31 @@
+"""Cornerstone octrees: sorted-key leaf arrays and domain decomposition.
+
+TPU-native rethink of the reference's ``cstone/tree/csarray.hpp`` and
+``cstone/domain/domaindecomp.hpp``: the octree IS a sorted array of SFC keys
+(node i spans [tree[i], tree[i+1])), counts come from vectorized
+searchsorted, and rebalancing is a scan + scatter — no pointers, no
+recursion.
+"""
+
+from sphexa_tpu.tree.csarray import (
+    compute_node_counts,
+    compute_octree,
+    make_root_tree,
+    make_uniform_tree,
+    node_levels,
+    rebalance_tree,
+    update_octree,
+)
+from sphexa_tpu.tree.decomposition import make_sfc_assignment, uniform_bins
+
+__all__ = [
+    "compute_node_counts",
+    "compute_octree",
+    "make_root_tree",
+    "make_uniform_tree",
+    "node_levels",
+    "rebalance_tree",
+    "update_octree",
+    "make_sfc_assignment",
+    "uniform_bins",
+]

@@ -1,0 +1,168 @@
+"""Cornerstone leaf-array octree build (count -> rebalance iteration).
+
+Re-designs the reference's ``cstone/tree/csarray.hpp`` (computeNodeCounts
+:203, calculateNodeOp :291, rebalanceTree :399, updateOctree :433,
+computeOctree :456) as vectorized array ops:
+
+- a tree is a sorted uint32 key array ``tree`` of length ``numLeaves+1``
+  with ``tree[0] == 0`` and ``tree[-1] == 2**30``; leaf ``i`` covers the key
+  range ``[tree[i], tree[i+1])`` and every leaf spans a power-of-8 range
+  aligned to its level (the cornerstone invariant, csarray.hpp:26-50);
+- particle counts per leaf are two vectorized ``searchsorted`` calls;
+- one rebalance step computes a per-node op (1 keep / 8 split / 0 merged
+  into parent), an exclusive scan of ops, and a scatter of new node keys.
+
+The build runs eagerly on host (numpy): tree construction happens at domain
+sync granularity, not per interaction, and its output feeds static-shaped
+device structures (cell grids, assignment bins). A fixed-capacity on-device
+variant can be slotted in later without changing callers.
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+from sphexa_tpu.dtypes import KEY_BITS
+
+KEY_RANGE = np.uint64(1) << np.uint64(3 * KEY_BITS)
+
+
+def _as_keys(a) -> np.ndarray:
+    """Keys are widened to uint64 on host so 2**30 (one-past-max) is exact."""
+    return np.asarray(a, dtype=np.uint64)
+
+
+def make_root_tree() -> np.ndarray:
+    """The minimal tree: a single root leaf covering the whole key space."""
+    return np.array([0, KEY_RANGE], dtype=np.uint64)
+
+
+def make_uniform_tree(level: int) -> np.ndarray:
+    """Fully refined tree at ``level``: 8**level equal leaves."""
+    n = 1 << (3 * level)
+    return (np.arange(n + 1, dtype=np.uint64) * (KEY_RANGE // np.uint64(n)))
+
+
+def node_levels(tree: np.ndarray) -> np.ndarray:
+    """Octree level of each leaf, from its key span (power-of-8 invariant)."""
+    spans = np.diff(_as_keys(tree))
+    levels = (3 * KEY_BITS - np.round(np.log2(spans.astype(np.float64))).astype(np.int64)) // 3
+    return levels
+
+
+def compute_node_counts(tree: np.ndarray, sorted_keys: np.ndarray) -> np.ndarray:
+    """Particle count per leaf via binary search over the sorted key array.
+
+    Equivalent of computeNodeCounts (csarray.hpp:203) — but where the
+    reference walks with upper/lower bounds per node, here a single
+    vectorized searchsorted over all node boundaries does the job.
+    """
+    tree = _as_keys(tree)
+    keys = _as_keys(sorted_keys)
+    edges = np.searchsorted(keys, tree, side="left")
+    return np.diff(edges).astype(np.int64)
+
+
+def _node_ops(tree: np.ndarray, counts: np.ndarray, bucket_size: int) -> np.ndarray:
+    """Per-leaf rebalance op: 8 = split, 1 = keep, 0 = merged into parent.
+
+    Mirrors the decision logic of calculateNodeOp (csarray.hpp:291): split
+    when over-full and not at max depth; merge 8 siblings into their parent
+    when the parent total fits in the bucket (op 1 on the first sibling
+    standing in for the parent, op 0 on the other seven).
+    """
+    tree = _as_keys(tree)
+    spans = np.diff(tree)
+    levels = node_levels(tree)
+    n = len(counts)
+
+    ops = np.ones(n, dtype=np.int64)
+    ops[(counts > bucket_size) & (levels < KEY_BITS)] = 8
+
+    # Merge candidates: groups of 8 consecutive leaves that are exact
+    # siblings (same parent range, aligned) with combined count <= bucket.
+    if n >= 8:
+        starts = tree[:-1]
+        parent_span = spans * np.uint64(8)
+        is_first_sibling = (
+            (np.arange(n) + 8 <= n)
+            & (starts % np.maximum(parent_span, 1) == 0)
+        )
+        idx = np.flatnonzero(is_first_sibling)
+        if len(idx):
+            # all 8 spans equal and contiguous -> true sibling group
+            span_ok = np.ones(len(idx), dtype=bool)
+            total = np.zeros(len(idx), dtype=np.int64)
+            for j in range(8):
+                span_ok &= spans[np.minimum(idx + j, n - 1)] == spans[idx]
+                total += counts[np.minimum(idx + j, n - 1)]
+            merge = span_ok & (total <= bucket_size) & (levels[idx] > 0)
+            for j in range(1, 8):
+                ops[idx[merge] + j] = 0
+            ops[idx[merge]] = 1  # becomes the parent
+            # tag the merge so the scatter step emits the parent key span
+            ops = ops.astype(np.int64)
+            merged_first = np.zeros(n, dtype=bool)
+            merged_first[idx[merge]] = True
+            return ops, merged_first
+    return ops, np.zeros(n, dtype=bool)
+
+
+def rebalance_tree(
+    tree: np.ndarray, counts: np.ndarray, bucket_size: int
+) -> Tuple[np.ndarray, bool]:
+    """One count-and-rebalance step; returns (new_tree, converged).
+
+    Equivalent of rebalanceTree (csarray.hpp:399).
+    """
+    tree = _as_keys(tree)
+    ops, merged_first = _node_ops(tree, counts, bucket_size)
+    converged = bool(np.all(ops == 1) and not merged_first.any())
+    if converged:
+        return tree, True
+
+    offsets = np.concatenate([[0], np.cumsum(ops)])
+    new_tree = np.zeros(offsets[-1] + 1, dtype=np.uint64)
+    spans = np.diff(tree)
+
+    keep = np.flatnonzero(ops == 1)
+    new_tree[offsets[keep]] = tree[keep]
+
+    split = np.flatnonzero(ops == 8)
+    if len(split):
+        child_span = spans[split] // np.uint64(8)
+        for j in range(8):
+            new_tree[offsets[split] + j] = tree[split] + np.uint64(j) * child_span
+    new_tree[-1] = KEY_RANGE
+    return new_tree, False
+
+
+def update_octree(
+    sorted_keys: np.ndarray, tree: np.ndarray, bucket_size: int
+) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """One iteration of (counts, rebalance); returns (tree, counts, converged).
+
+    Equivalent of updateOctree (csarray.hpp:433).
+    """
+    counts = compute_node_counts(tree, sorted_keys)
+    new_tree, converged = rebalance_tree(tree, counts, bucket_size)
+    if not converged:
+        counts = compute_node_counts(new_tree, sorted_keys)
+    return new_tree, counts, converged
+
+
+def compute_octree(
+    sorted_keys: np.ndarray, bucket_size: int, max_iterations: int = 64
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Build a converged cornerstone tree from scratch.
+
+    Equivalent of computeOctree (csarray.hpp:456): iterate update_octree
+    from the root until no node wants to split or merge.
+    """
+    tree = make_root_tree()
+    counts = compute_node_counts(tree, sorted_keys)
+    for _ in range(max_iterations):
+        tree, counts, converged = update_octree(sorted_keys, tree, bucket_size)
+        if converged:
+            break
+    return tree, counts

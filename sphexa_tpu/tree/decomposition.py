@@ -1,0 +1,52 @@
+"""SFC domain decomposition: equal-count key ranges per device.
+
+Equivalent of the reference's ``cstone/domain/domaindecomp.hpp``
+(uniformBins :49, SfcAssignment/makeSfcAssignment :74-116): split the
+global, SFC-ordered leaf counts into contiguous segments of approximately
+equal particle count. Each segment becomes the key range owned by one
+device in the mesh.
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+
+def uniform_bins(tree: np.ndarray, counts: np.ndarray, num_bins: int) -> np.ndarray:
+    """Choose ``num_bins + 1`` split keys so each bin holds ~equal counts.
+
+    Returns an array of SFC keys; bin ``r`` owns ``[keys[r], keys[r+1])``.
+    Splits always fall on leaf boundaries of ``tree`` (like the reference,
+    which never splits a leaf across ranks).
+    """
+    tree = np.asarray(tree, dtype=np.uint64)
+    csum = np.concatenate([[0], np.cumsum(counts)])
+    total = csum[-1]
+    targets = (np.arange(1, num_bins) * total) // num_bins
+    # leaf index whose cumulative count first reaches each target
+    split_leaves = np.searchsorted(csum, targets, side="left")
+    split_leaves = np.clip(split_leaves, 1, len(tree) - 1)
+    # enforce strictly increasing boundaries even for tiny trees
+    split_leaves = np.maximum.accumulate(split_leaves)
+    for i in range(1, len(split_leaves)):
+        if split_leaves[i] <= split_leaves[i - 1]:
+            split_leaves[i] = min(split_leaves[i - 1] + 1, len(tree) - 1)
+    return np.concatenate([[tree[0]], tree[split_leaves], [tree[-1]]])
+
+
+def make_sfc_assignment(
+    sorted_keys: np.ndarray, num_ranks: int, bucket_size: int = 64
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Build a tree over all keys and return (assignment_keys, counts_per_rank).
+
+    Equivalent of makeSfcAssignment (domaindecomp.hpp:116): the returned
+    boundary keys define, for every device, the contiguous Hilbert-key slab
+    it owns. Balance quality is bounded by bucket_size granularity.
+    """
+    from sphexa_tpu.tree.csarray import compute_octree
+
+    tree, counts = compute_octree(sorted_keys, bucket_size)
+    bins = uniform_bins(tree, counts, num_ranks)
+    keys = np.asarray(sorted_keys, dtype=np.uint64)
+    edges = np.searchsorted(keys, bins, side="left")
+    return bins, np.diff(edges)

@@ -1,0 +1,3 @@
+from sphexa_tpu.util.blocking import blocked_map
+
+__all__ = ["blocked_map"]

@@ -1,0 +1,26 @@
+"""Blocked mapping over particle ranges.
+
+The SPH interaction ops materialize (block, ngmax) gathered neighbor-field
+tiles; mapping a block body with lax.map keeps the transient footprint at
+``block * ngmax * n_fields * 4`` bytes instead of ``N * ...``, while XLA
+still fuses everything inside one block into a single kernel. This plays
+the role that target-group tiling plays in the reference's GPU traversal
+(cstone/traversal/groups.cuh): bounded on-chip working sets over an
+SFC-ordered particle range.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def blocked_map(body, n: int, block: int):
+    """Run ``body(idx_block)`` over ceil(n/block) index blocks; concat results.
+
+    ``body`` receives an int32 index vector of length ``block`` (tail indices
+    clamped to n-1; the duplicate rows are discarded) and returns a pytree of
+    per-particle arrays with leading dim ``block``.
+    """
+    num_blocks = -(-n // block)
+    idx = jnp.arange(num_blocks * block, dtype=jnp.int32).reshape(num_blocks, block)
+    out = jax.lax.map(lambda ib: body(jnp.minimum(ib, n - 1)), idx)
+    return jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:])[:n], out)

@@ -8,7 +8,13 @@ the test double.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests are CPU-only. NOTE: if the axon TPU tunnel is wedged, run pytest as
+#   env -u PALLAS_AXON_POOL_IPS python -m pytest ...
+# The axon sitecustomize hook registers the TPU PJRT client at interpreter
+# boot (before this file runs) whenever that var is set, and a dead tunnel
+# then blocks the first jax operation even under JAX_PLATFORMS=cpu.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,132 @@
+"""Neighbor-search correctness vs brute-force all-pairs reference, mirroring
+the reference's unit/neighbors/findneighbors.cpp + all_to_all.hpp strategy.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sphexa_tpu.sfc import Box, BoundaryType, compute_sfc_keys
+from sphexa_tpu.neighbors import (
+    NeighborConfig,
+    choose_grid_level,
+    estimate_cell_cap,
+    find_neighbors,
+)
+
+
+def brute_force_neighbors(x, y, z, h, box: Box):
+    """All-pairs reference (mirrors unit/neighbors/all_to_all.hpp)."""
+    pos = np.stack([x, y, z], axis=1).astype(np.float64)
+    d = pos[:, None, :] - pos[None, :, :]
+    L = np.asarray(box.lengths, dtype=np.float64)
+    per = np.asarray(box.periodic_mask)
+    d = np.where(per, d - L * np.round(d / L), d)
+    d2 = (d**2).sum(-1)
+    r2 = (2.0 * np.asarray(h, dtype=np.float64)) ** 2
+    hit = d2 < r2[:, None]
+    np.fill_diagonal(hit, False)
+    return hit
+
+
+def setup_case(rng, n, boundary, h_val=0.08):
+    box = Box.create(-0.5, 0.5, boundary=boundary)
+    x = rng.uniform(-0.5, 0.5, n).astype(np.float32)
+    y = rng.uniform(-0.5, 0.5, n).astype(np.float32)
+    z = rng.uniform(-0.5, 0.5, n).astype(np.float32)
+    h = np.full(n, h_val, np.float32)
+    keys = np.asarray(compute_sfc_keys(jnp.asarray(x), jnp.asarray(y), jnp.asarray(z), box))
+    order = np.argsort(keys, kind="stable")
+    return box, x[order], y[order], z[order], h[order], np.sort(keys)
+
+
+def run_and_compare(rng, n, boundary, h_val=0.08):
+    box, x, y, z, h, keys = setup_case(rng, n, boundary, h_val)
+    level = choose_grid_level(np.asarray(box.lengths), h.max())
+    cap = estimate_cell_cap(keys, level)
+    cfg = NeighborConfig(level=level, cap=cap, ngmax=200, block=256)
+    nidx, nmask, nc, occ = find_neighbors(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(z), jnp.asarray(h),
+        jnp.asarray(keys), box, cfg,
+    )
+    assert int(occ) <= cap, "cell cap overflow"
+    ref = brute_force_neighbors(x, y, z, h, box)
+
+    nidx, nmask, nc = np.asarray(nidx), np.asarray(nmask), np.asarray(nc)
+    np.testing.assert_array_equal(nc, ref.sum(1), err_msg="neighbor counts differ")
+    for i in range(n):
+        got = set(nidx[i][nmask[i]])
+        expect = set(np.flatnonzero(ref[i]))
+        assert got == expect, f"particle {i}: missing {expect-got}, extra {got-expect}"
+
+
+class TestFindNeighbors:
+    def test_periodic_box(self, rng):
+        run_and_compare(rng, 500, BoundaryType.periodic)
+
+    def test_open_box(self, rng):
+        run_and_compare(rng, 500, BoundaryType.open)
+
+    def test_large_h_coarse_grid(self, rng):
+        # big search radius -> level 1 grid, stencil covers whole box
+        run_and_compare(rng, 200, BoundaryType.periodic, h_val=0.2)
+
+    def test_varying_h(self, rng):
+        box, x, y, z, h, keys = setup_case(rng, 400, BoundaryType.periodic)
+        h = (0.04 + 0.04 * rng.uniform(size=400)).astype(np.float32)
+        level = choose_grid_level(np.asarray(box.lengths), h.max())
+        cap = estimate_cell_cap(keys, level)
+        cfg = NeighborConfig(level=level, cap=cap, ngmax=300, block=128)
+        nidx, nmask, nc, occ = find_neighbors(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(z), jnp.asarray(h),
+            jnp.asarray(keys), box, cfg,
+        )
+        ref = brute_force_neighbors(x, y, z, h, box)
+        np.testing.assert_array_equal(np.asarray(nc), ref.sum(1))
+
+    def test_ngmax_truncation_keeps_closest(self, rng):
+        box, x, y, z, h, keys = setup_case(rng, 300, BoundaryType.periodic, h_val=0.15)
+        level = choose_grid_level(np.asarray(box.lengths), h.max())
+        cap = estimate_cell_cap(keys, level)
+        cfg = NeighborConfig(level=level, cap=cap, ngmax=10, block=64)
+        nidx, nmask, nc, _ = find_neighbors(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(z), jnp.asarray(h),
+            jnp.asarray(keys), box, cfg,
+        )
+        nidx, nmask, nc = np.asarray(nidx), np.asarray(nmask), np.asarray(nc)
+        ref = brute_force_neighbors(x, y, z, h, box)
+        # counts still report the true (untruncated) number
+        np.testing.assert_array_equal(nc, ref.sum(1))
+        # kept neighbors are the closest ones
+        pos = np.stack([x, y, z], 1).astype(np.float64)
+        L = np.asarray(box.lengths, dtype=np.float64)
+        for i in range(0, 300, 37):
+            if nc[i] <= 10:
+                continue
+            d = pos[ref[i]] - pos[i]
+            d -= L * np.round(d / L)
+            dist_all = np.sort((d**2).sum(-1))
+            dk = pos[nidx[i][nmask[i]]] - pos[i]
+            dk -= L * np.round(dk / L)
+            got_max = (dk**2).sum(-1).max()
+            assert got_max <= dist_all[9] * (1 + 1e-5)
+
+    def test_empty_regions(self, rng):
+        # particles only in one octant; empty cells must not break anything
+        box = Box.create(-0.5, 0.5, boundary=BoundaryType.periodic)
+        x = rng.uniform(-0.5, -0.3, 200).astype(np.float32)
+        y = rng.uniform(-0.5, -0.3, 200).astype(np.float32)
+        z = rng.uniform(-0.5, -0.3, 200).astype(np.float32)
+        h = np.full(200, 0.03, np.float32)
+        keys = np.asarray(compute_sfc_keys(jnp.asarray(x), jnp.asarray(y), jnp.asarray(z), box))
+        order = np.argsort(keys, kind="stable")
+        x, y, z, h, keys = x[order], y[order], z[order], h[order], np.sort(keys)
+        level = choose_grid_level(np.asarray(box.lengths), h.max())
+        cap = estimate_cell_cap(keys, level)
+        cfg = NeighborConfig(level=level, cap=cap, ngmax=100, block=64)
+        nidx, nmask, nc, _ = find_neighbors(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(z), jnp.asarray(h),
+            jnp.asarray(keys), box, cfg,
+        )
+        ref = brute_force_neighbors(x, y, z, h, box)
+        np.testing.assert_array_equal(np.asarray(nc), ref.sum(1))

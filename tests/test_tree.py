@@ -1,0 +1,97 @@
+"""Cornerstone tree invariant tests, mirroring the reference's
+domain/test/unit/tree/csarray.cpp and unit/domain/domaindecomp.cpp.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from sphexa_tpu.dtypes import KEY_BITS
+from sphexa_tpu.sfc import Box, BoundaryType, compute_sfc_keys
+from sphexa_tpu.tree import (
+    compute_node_counts,
+    compute_octree,
+    make_root_tree,
+    make_uniform_tree,
+    make_sfc_assignment,
+    node_levels,
+    uniform_bins,
+)
+
+KEY_RANGE = 1 << (3 * KEY_BITS)
+
+
+def random_keys(rng, n):
+    return np.sort(rng.integers(0, KEY_RANGE, n).astype(np.uint64))
+
+
+def check_invariants(tree, keys, bucket_size):
+    tree = np.asarray(tree)
+    assert tree[0] == 0 and tree[-1] == KEY_RANGE
+    assert np.all(np.diff(tree.astype(np.int64)) > 0)
+    spans = np.diff(tree)
+    # power-of-8 spans aligned to their own size (cornerstone invariant)
+    assert np.all((spans & (spans - 1)) == 0)
+    assert np.all(np.log2(spans.astype(float)) % 3 == 0)
+    assert np.all(tree[:-1] % spans == 0)
+    counts = compute_node_counts(tree, keys)
+    assert counts.sum() == len(keys)
+    # converged: no leaf over-full unless at max depth
+    levels = node_levels(tree)
+    assert np.all((counts <= bucket_size) | (levels == KEY_BITS))
+
+
+class TestCsarray:
+    def test_root_and_uniform(self):
+        assert list(make_root_tree()) == [0, KEY_RANGE]
+        t = make_uniform_tree(2)
+        assert len(t) == 65
+        assert np.all(node_levels(t) == 2)
+
+    def test_counts(self, rng):
+        keys = random_keys(rng, 1000)
+        tree = make_uniform_tree(1)
+        counts = compute_node_counts(tree, keys)
+        assert counts.sum() == 1000
+        # roughly uniform distribution over 8 octants
+        assert counts.min() > 50
+
+    def test_build_random(self, rng):
+        keys = random_keys(rng, 20000)
+        tree, counts = compute_octree(keys, bucket_size=64)
+        check_invariants(tree, keys, 64)
+
+    def test_build_clustered(self, rng):
+        # strongly clustered keys exercise deep refinement + coarse siblings
+        a = rng.integers(0, KEY_RANGE // 1000, 5000)
+        b = rng.integers(KEY_RANGE - 500, KEY_RANGE, 5000)
+        keys = np.sort(np.concatenate([a, b]).astype(np.uint64))
+        tree, counts = compute_octree(keys, bucket_size=32)
+        check_invariants(tree, keys, 32)
+
+    def test_rebuild_is_stable(self, rng):
+        keys = random_keys(rng, 5000)
+        tree, _ = compute_octree(keys, bucket_size=64)
+        tree2, _ = compute_octree(keys, bucket_size=64)
+        np.testing.assert_array_equal(tree, tree2)
+
+
+class TestDecomposition:
+    def test_uniform_bins_balance(self, rng):
+        keys = random_keys(rng, 50000)
+        tree, counts = compute_octree(keys, bucket_size=64)
+        bins = uniform_bins(tree, counts, 8)
+        assert len(bins) == 9
+        assert bins[0] == 0 and bins[-1] == KEY_RANGE
+        edges = np.searchsorted(keys, bins)
+        per_rank = np.diff(edges)
+        assert per_rank.sum() == len(keys)
+        # equal-count split within bucket granularity
+        assert per_rank.max() - per_rank.min() < 3 * 64
+
+    def test_assignment_covers_all(self, rng):
+        box = Box.create(-1, 1, boundary=BoundaryType.periodic)
+        pos = [jnp.asarray(rng.uniform(-1, 1, 4096), jnp.float32) for _ in range(3)]
+        keys = np.sort(np.asarray(compute_sfc_keys(*pos, box)))
+        bins, per_rank = make_sfc_assignment(keys, 4)
+        assert per_rank.sum() == 4096
+        assert per_rank.min() > 0
