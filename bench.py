@@ -1,0 +1,59 @@
+"""Benchmark: particle-updates/sec/chip on the Sedov blast (driver contract).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: BASELINE.md's north star is Sedov 100^3 within 2x of sphexa-cuda
+per-chip throughput (16xA100 vs v5e-16). The reference publishes no absolute
+numbers (BASELINE.md), so the per-chip baseline constant below is the
+working estimate of sphexa-cuda on one A100 for this problem size;
+vs_baseline = value / BASELINE_UPDATES_PER_SEC.
+"""
+
+import json
+import os
+import sys
+import time
+
+# sphexa-cuda per-A100 working estimate for Sedov ~1e6 (no published number)
+BASELINE_UPDATES_PER_SEC = 2.0e7
+
+SIDE = int(os.environ.get("BENCH_SIDE", "100"))
+WARMUP = 2
+STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+
+
+def main() -> int:
+    import jax
+    from sphexa_tpu.init import init_sedov
+    from sphexa_tpu.simulation import Simulation
+
+    n = SIDE**3
+    state, box, const = init_sedov(SIDE)
+    sim = Simulation(state, box, const, prop="std", block=8192)
+
+    for _ in range(WARMUP):
+        sim.step()
+    jax.block_until_ready(sim.state.x)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        sim.step()
+    jax.block_until_ready(sim.state.x)
+    elapsed = time.perf_counter() - t0
+
+    updates_per_sec = n * STEPS / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": f"particle-updates/sec/chip (Sedov {SIDE}^3, std SPH)",
+                "value": round(updates_per_sec, 1),
+                "unit": "particles/s",
+                "vs_baseline": round(updates_per_sec / BASELINE_UPDATES_PER_SEC, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

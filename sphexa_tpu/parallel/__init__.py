@@ -1,0 +1,17 @@
+"""Multi-device execution: meshes, shardings, distributed steps.
+
+TPU-native replacement of the reference's MPI layer (SURVEY.md §2e): the
+particle arrays are sharded over a 1-D device mesh in SFC order (the analog
+of rank-owned Hilbert slabs, P1), and the jitted step runs under GSPMD so
+XLA inserts the halo gathers, redistribution all-to-alls and min/sum
+collectives that the reference encodes as explicit MPI choreography
+(P2-P4). ICI replaces GPU-direct RDMA natively (P7).
+"""
+
+from sphexa_tpu.parallel.mesh import (
+    make_mesh,
+    make_sharded_step,
+    shard_state,
+)
+
+__all__ = ["make_mesh", "make_sharded_step", "shard_state"]

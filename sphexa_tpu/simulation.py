@@ -28,6 +28,28 @@ _PROPAGATORS: Dict[str, Callable] = {
 }
 
 
+def make_propagator_config(
+    state: ParticleState,
+    box: Box,
+    const: SimConstants,
+    ngmax: Optional[int] = None,
+    block: int = 2048,
+    curve: str = "hilbert",
+    min_cap: int = 0,
+) -> PropagatorConfig:
+    """Size the static neighbor-search config from the current particle
+    distribution (single source of truth — used by Simulation, tests and
+    the driver entry points)."""
+    h_max = float(jnp.max(state.h))
+    level = choose_grid_level(np.asarray(box.lengths), h_max)
+    keys = np.asarray(compute_sfc_keys(state.x, state.y, state.z, box, curve=curve))
+    cap = max(estimate_cell_cap(keys, level), min_cap)
+    nbr = NeighborConfig(
+        level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block, curve=curve
+    )
+    return PropagatorConfig(const=const, nbr=nbr, curve=curve, block=block)
+
+
 class Simulation:
     """Owns state + static configs; reconfigures (recompiles) only when the
     cell grid no longer covers the interaction radius or a cell overflows
@@ -56,18 +78,9 @@ class Simulation:
 
     # -- static config management ------------------------------------------
     def _configure(self, min_cap: int = 0):
-        h_max = float(jnp.max(self.state.h))
-        level = choose_grid_level(np.asarray(self.box.lengths), h_max)
-        keys = np.asarray(
-            compute_sfc_keys(self.state.x, self.state.y, self.state.z, self.box,
-                             curve=self.curve)
-        )
-        cap = max(estimate_cell_cap(np.sort(keys), level), min_cap)
-        nbr = NeighborConfig(
-            level=level, cap=cap, ngmax=self.ngmax, block=self.block, curve=self.curve
-        )
-        self._cfg = PropagatorConfig(
-            const=self.const, nbr=nbr, curve=self.curve, block=self.block
+        self._cfg = make_propagator_config(
+            self.state, self.box, self.const,
+            ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
         )
 
     def _config_still_valid(self, diagnostics) -> bool:

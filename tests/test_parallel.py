@@ -1,0 +1,73 @@
+"""Multi-device tests on the virtual 8-device CPU mesh: the sharded step
+must (a) run with real cross-device shardings and (b) agree with the
+single-device step bit-for-bit-ish. The analog of the reference's
+oversubscribed-mpiexec integration tests (domain/test/integration_mpi/).
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.parallel import make_mesh, make_sharded_step, shard_state
+from sphexa_tpu.propagator import step_hydro_std
+from sphexa_tpu.simulation import make_propagator_config
+
+
+def make_cfg(state, box, const, block=512):
+    return make_propagator_config(state, box, const, block=block)
+
+
+class TestShardedStep:
+    def test_eight_device_step_matches_single(self):
+        assert jax.device_count() >= 8, "conftest should provide 8 CPU devices"
+        state, box, const = init_sedov(16)  # 4096 particles / 8 devices
+        cfg = make_cfg(state, box, const)
+
+        # single-device reference
+        ref_state, ref_box, ref_diag = step_hydro_std(state, box, cfg)
+
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg)
+        out_state, out_box, out_diag = step(sstate, box)
+
+        # the sharded result is the same physics
+        np.testing.assert_allclose(
+            np.asarray(out_state.x), np.asarray(ref_state.x), rtol=1e-5, atol=1e-7
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_state.temp), np.asarray(ref_state.temp), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            float(out_diag["dt"]), float(ref_diag["dt"]), rtol=1e-5
+        )
+
+    def test_sharded_arrays_stay_sharded(self):
+        state, box, const = init_sedov(16)
+        cfg = make_cfg(state, box, const)
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg)
+        out_state, _, _ = step(sstate, box)
+        # a replicated array also spans 8 devices — assert the per-device
+        # shard really is 1/8th of the rows
+        shard_rows = out_state.x.addressable_shards[0].data.shape[0]
+        assert shard_rows == out_state.x.shape[0] // 8, "output lost its 8-way sharding"
+
+    def test_multiple_steps_stable(self):
+        state, box, const = init_sedov(16)
+        cfg = make_cfg(state, box, const)
+        mesh = make_mesh(8)
+        s = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg)
+        for _ in range(3):
+            s, box, d = step(s, box)
+        assert np.all(np.isfinite(np.asarray(s.x)))
+        assert float(d["dt"]) > 0
+
+    def test_indivisible_count_rejected(self):
+        state, box, const = init_sedov(15)  # 3375 not divisible by 8
+        mesh = make_mesh(8)
+        with pytest.raises(ValueError, match="not divisible"):
+            shard_state(state, mesh)
