@@ -97,11 +97,13 @@ class Simulation:
         overflow (truncated neighbor candidates) is discarded and re-run
         under a freshly sized config — overflow must never corrupt state."""
         step_fn = _PROPAGATORS[self.prop_name]
+        reconfigured = False
         for _attempt in range(3):
             new_state, new_box, diagnostics = step_fn(self.state, self.box, self._cfg)
             if int(diagnostics["occupancy"]) <= self._cfg.nbr.cap:
                 break
             self._configure(min_cap=int(diagnostics["occupancy"]))
+            reconfigured = True
         else:
             raise RuntimeError("neighbor cell cap failed to converge in 3 attempts")
         self.state = new_state
@@ -109,7 +111,10 @@ class Simulation:
         self.iteration += 1
         if not self._config_still_valid(diagnostics):
             self._configure()
-        return {k: float(v) for k, v in diagnostics.items()}
+            reconfigured = True
+        out = {k: float(v) for k, v in diagnostics.items()}
+        out["reconfigured"] = float(reconfigured)
+        return out
 
     def run(self, num_steps: int, log_every: int = 0, printer=print):
         for _ in range(num_steps):

@@ -8,18 +8,21 @@ the test double.
 
 import os
 
-# Tests are CPU-only. NOTE: if the axon TPU tunnel is wedged, run pytest as
-#   env -u PALLAS_AXON_POOL_IPS python -m pytest ...
-# The axon sitecustomize hook registers the TPU PJRT client at interpreter
-# boot (before this file runs) whenever that var is set, and a dead tunnel
-# then blocks the first jax operation even under JAX_PLATFORMS=cpu.
+# Tests are CPU-only. The axon sitecustomize hook pre-imports jax at
+# interpreter boot with JAX_PLATFORMS=axon, so plain env-var assignment here
+# is too late for jax's config — override through jax.config instead.
+# XLA_FLAGS *is* still read lazily at first backend init, so setting it here
+# works as long as no jax op has run yet.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
