@@ -37,16 +37,23 @@ def main(argv=None) -> int:
 
     from sphexa_tpu.init import init_sedov
     from sphexa_tpu.observables import conserved_quantities
-    from sphexa_tpu.simulation import Simulation
+    from sphexa_tpu.simulation import _PROPAGATORS, Simulation
 
     initializers = {"sedov": init_sedov}
     if args.init not in initializers:
         print(f"unknown --init {args.init!r}; available: {sorted(initializers)}",
               file=sys.stderr)
         return 2
+    if args.prop not in _PROPAGATORS:
+        print(f"unknown --prop {args.prop!r}; available: {sorted(_PROPAGATORS)}",
+              file=sys.stderr)
+        return 2
+    if args.avclean and args.prop != "ve":
+        print("--avclean only applies to --prop ve; ignoring", file=sys.stderr)
     state, box, const = initializers[args.init](args.side)
 
-    sim = Simulation(state, box, const, prop=args.prop)
+    sim = Simulation(state, box, const, prop=args.prop,
+                     av_clean=args.avclean and args.prop == "ve")
     log = (lambda *a, **k: None) if args.quiet else print
     log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
 

@@ -22,11 +22,11 @@ import jax.numpy as jnp
 from sphexa_tpu.neighbors.cell_list import NeighborConfig, find_neighbors
 from sphexa_tpu.sfc.box import Box, make_global_box
 from sphexa_tpu.sfc.keys import compute_sfc_keys
-from sphexa_tpu.sph import hydro_std
+from sphexa_tpu.sph import hydro_std, hydro_ve
 from sphexa_tpu.sph.kernels import update_h
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
 from sphexa_tpu.sph.positions import compute_positions
-from sphexa_tpu.sph.timestep import compute_timestep
+from sphexa_tpu.sph.timestep import compute_timestep, rho_timestep
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +37,7 @@ class PropagatorConfig:
     nbr: NeighborConfig
     curve: str = "hilbert"
     block: int = 2048
+    av_clean: bool = False
 
 
 def _sort_by_keys(state: ParticleState, box: Box, curve: str):
@@ -54,10 +55,41 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str):
     return jax.tree.map(maybe_gather, state), sorted_keys
 
 
+def _integrate_and_finish(
+    state: ParticleState, box: Box, const: SimConstants,
+    ax, ay, az, du, dt, nc, occ, rho, extra=None,
+):
+    """Shared step tail: drift/kick + PBC wrap, smoothing-length nudge,
+    state rebuild, diagnostics. Every propagator's force stage funnels
+    through here (the analog of the common trailing sequence of
+    std_hydro.hpp/ve_hydro.hpp step())."""
+    fields = (state.x, state.y, state.z, state.x_m1, state.y_m1, state.z_m1,
+              state.vx, state.vy, state.vz, state.h, state.temp, du, state.du_m1)
+    (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, du, du_m1) = compute_positions(
+        fields, ax, ay, az, dt, state.min_dt, box, const
+    )
+    new_h = update_h(const.ng0, nc + 1, h)
+    new_state = dataclasses.replace(
+        state,
+        x=nx, y=ny, z=nz, x_m1=dxm, y_m1=dym, z_m1=dzm,
+        vx=vx, vy=vy, vz=vz, h=new_h, temp=temp, du=du, du_m1=du_m1,
+        ttot=state.ttot + dt, min_dt=dt, min_dt_m1=state.min_dt,
+        **(extra or {}),
+    )
+    diagnostics = {
+        "dt": dt,
+        "nc_mean": jnp.mean(nc.astype(jnp.float32)) + 1.0,
+        "nc_max": jnp.max(nc) + 1,
+        "occupancy": occ,
+        "rho_max": jnp.max(rho),
+    }
+    return new_state, box, diagnostics
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def step_hydro_std(
     state: ParticleState, box: Box, cfg: PropagatorConfig
-) -> Tuple[ParticleState, Dict[str, jax.Array]]:
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
     """One standard-SPH time step (std_hydro.hpp:123-175 sequence).
 
     box regrow -> sort -> neighbors -> density -> EOS -> IAD ->
@@ -84,26 +116,66 @@ def step_hydro_std(
     )
 
     dt = compute_timestep(state.min_dt, dt_courant, const=const)
+    return _integrate_and_finish(state, box, const, ax, ay, az, du, dt, nc, occ, rho)
 
-    fields = (x, y, z, state.x_m1, state.y_m1, state.z_m1,
-              state.vx, state.vy, state.vz, h, state.temp, du, state.du_m1)
-    (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, du, du_m1) = compute_positions(
-        fields, ax, ay, az, dt, state.min_dt, box, const
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def step_hydro_ve(
+    state: ParticleState, box: Box, cfg: PropagatorConfig
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
+    """One generalized-volume-element SPH time step.
+
+    Mirrors HydroVeProp::computeForces (ve_hydro.hpp:131-208): sort ->
+    neighbors -> xmass -> ve_def_gradh -> EOS -> IAD -> divv/curlv ->
+    AV switches -> momentum/energy [avClean] -> timestep -> positions ->
+    smoothing-length update. The reference's halo exchanges between stages
+    vanish: XLA materializes whatever communication the shardings imply.
+    """
+    const = cfg.const
+    box = make_global_box(state.x, state.y, state.z, box)
+    state, keys = _sort_by_keys(state, box, cfg.curve)
+    x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
+    vx, vy, vz = state.vx, state.vy, state.vz
+
+    nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
+
+    xm = hydro_ve.compute_xmass(x, y, z, h, m, nidx, nmask, box, const, cfg.block)
+    kx, gradh = hydro_ve.compute_ve_def_gradh(
+        x, y, z, h, m, xm, nidx, nmask, box, const, cfg.block
+    )
+    prho, c, rho, p = hydro_ve.compute_eos_ve(state.temp, m, kx, xm, gradh, const)
+
+    c11, c12, c13, c22, c23, c33 = hydro_std.compute_iad(
+        x, y, z, h, xm / kx, nidx, nmask, box, const, cfg.block
+    )
+    dvout = hydro_ve.compute_iad_divv_curlv(
+        x, y, z, vx, vy, vz, h, kx, xm,
+        c11, c12, c13, c22, c23, c33,
+        nidx, nmask, box, const, cfg.block, with_gradv=cfg.av_clean,
+    )
+    if cfg.av_clean:
+        divv, curlv, *gradv = dvout
+        gradv = tuple(gradv)
+    else:
+        divv, curlv = dvout
+        gradv = None
+
+    dt_rho = rho_timestep(divv, const)
+
+    alpha = hydro_ve.compute_av_switches(
+        x, y, z, vx, vy, vz, h, c, kx, xm, divv, state.alpha,
+        c11, c12, c13, c22, c23, c33,
+        nidx, nmask, box, state.min_dt, const, cfg.block,
     )
 
-    new_h = update_h(const.ng0, nc + 1, h)
-
-    new_state = dataclasses.replace(
-        state,
-        x=nx, y=ny, z=nz, x_m1=dxm, y_m1=dym, z_m1=dzm,
-        vx=vx, vy=vy, vz=vz, h=new_h, temp=temp, du=du, du_m1=du_m1,
-        ttot=state.ttot + dt, min_dt=dt, min_dt_m1=state.min_dt,
+    ax, ay, az, du, dt_courant = hydro_ve.compute_momentum_energy_ve(
+        x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
+        c11, c12, c13, c22, c23, c33,
+        nidx, nmask, nc, box, const, cfg.block, gradv=gradv,
     )
-    diagnostics = {
-        "dt": dt,
-        "nc_mean": jnp.mean(nc.astype(jnp.float32)) + 1.0,
-        "nc_max": jnp.max(nc) + 1,
-        "occupancy": occ,
-        "rho_max": jnp.max(rho),
-    }
-    return new_state, box, diagnostics
+
+    dt = compute_timestep(state.min_dt, dt_courant, dt_rho, const=const)
+    return _integrate_and_finish(
+        state, box, const, ax, ay, az, du, dt, nc, occ, rho,
+        extra={"alpha": alpha},
+    )

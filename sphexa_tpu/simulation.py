@@ -18,13 +18,14 @@ from sphexa_tpu.neighbors.cell_list import (
     choose_grid_level,
     estimate_cell_cap,
 )
-from sphexa_tpu.propagator import PropagatorConfig, step_hydro_std
+from sphexa_tpu.propagator import PropagatorConfig, step_hydro_std, step_hydro_ve
 from sphexa_tpu.sfc.box import Box
 from sphexa_tpu.sfc.keys import compute_sfc_keys
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
 
 _PROPAGATORS: Dict[str, Callable] = {
     "std": step_hydro_std,
+    "ve": step_hydro_ve,
 }
 
 
@@ -36,6 +37,7 @@ def make_propagator_config(
     block: int = 2048,
     curve: str = "hilbert",
     min_cap: int = 0,
+    av_clean: bool = False,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -47,7 +49,9 @@ def make_propagator_config(
     nbr = NeighborConfig(
         level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block, curve=curve
     )
-    return PropagatorConfig(const=const, nbr=nbr, curve=curve, block=block)
+    return PropagatorConfig(
+        const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean
+    )
 
 
 class Simulation:
@@ -64,6 +68,7 @@ class Simulation:
         ngmax: Optional[int] = None,
         block: int = 2048,
         curve: str = "hilbert",
+        av_clean: bool = False,
     ):
         self.state = state
         self.box = box
@@ -71,6 +76,7 @@ class Simulation:
         self.prop_name = prop
         self.block = block
         self.curve = curve
+        self.av_clean = av_clean
         self.ngmax = ngmax or const.ngmax
         self.iteration = 0
         self._cfg: Optional[PropagatorConfig] = None
@@ -81,6 +87,7 @@ class Simulation:
         self._cfg = make_propagator_config(
             self.state, self.box, self.const,
             ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
+            av_clean=self.av_clean,
         )
 
     def _config_still_valid(self, diagnostics) -> bool:

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from sphexa_tpu.sfc.box import Box
 from sphexa_tpu.sph.kernels import artificial_viscosity, sinc_kernel, ts_k_courant
-from sphexa_tpu.sph.pairs import mmax, msum, pair_geometry
+from sphexa_tpu.sph.pairs import iad_project, mmax, msum, pair_geometry
 from sphexa_tpu.sph.particles import SimConstants
 from sphexa_tpu.util.blocking import blocked_map
 
@@ -122,12 +122,15 @@ def compute_momentum_energy_std(
         vijsignal = c_i + c_j - 3.0 * w_ij
         maxvsignal = mmax(g.mask, vijsignal)
 
-        tA1_i = c11[idx][:, None] * g.rx + c12[idx][:, None] * g.ry + c13[idx][:, None] * g.rz
-        tA2_i = c12[idx][:, None] * g.rx + c22[idx][:, None] * g.ry + c23[idx][:, None] * g.rz
-        tA3_i = c13[idx][:, None] * g.rx + c23[idx][:, None] * g.ry + c33[idx][:, None] * g.rz
-        tA1_j = c11[g.nj] * g.rx + c12[g.nj] * g.ry + c13[g.nj] * g.rz
-        tA2_j = c12[g.nj] * g.rx + c22[g.nj] * g.ry + c23[g.nj] * g.rz
-        tA3_j = c13[g.nj] * g.rx + c23[g.nj] * g.ry + c33[g.nj] * g.rz
+        tA1_i, tA2_i, tA3_i = iad_project(
+            c11[idx][:, None], c12[idx][:, None], c13[idx][:, None],
+            c22[idx][:, None], c23[idx][:, None], c33[idx][:, None],
+            g.rx, g.ry, g.rz, sign=1.0,
+        )
+        tA1_j, tA2_j, tA3_j = iad_project(
+            c11[g.nj], c12[g.nj], c13[g.nj], c22[g.nj], c23[g.nj], c33[g.nj],
+            g.rx, g.ry, g.rz, sign=1.0,
+        )
 
         rho_i = rho[idx][:, None]
         rho_j = rho[g.nj]

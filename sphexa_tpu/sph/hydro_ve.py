@@ -20,7 +20,7 @@ from sphexa_tpu.sph.kernels import (
     sinc_kernel_derivative,
     ts_k_courant,
 )
-from sphexa_tpu.sph.pairs import mmax, msum, pair_geometry
+from sphexa_tpu.sph.pairs import iad_project, mmax, msum, pair_geometry
 from sphexa_tpu.sph.particles import SimConstants
 from sphexa_tpu.util.blocking import blocked_map
 
@@ -108,9 +108,11 @@ def compute_iad_divv_curlv(
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
         w = sinc_kernel(g.v1, const.sinc_index)
 
-        tA1 = -(c11[idx][:, None] * g.rx + c12[idx][:, None] * g.ry + c13[idx][:, None] * g.rz) * w
-        tA2 = -(c12[idx][:, None] * g.rx + c22[idx][:, None] * g.ry + c23[idx][:, None] * g.rz) * w
-        tA3 = -(c13[idx][:, None] * g.rx + c23[idx][:, None] * g.ry + c33[idx][:, None] * g.rz) * w
+        tA1, tA2, tA3 = iad_project(
+            c11[idx][:, None], c12[idx][:, None], c13[idx][:, None],
+            c22[idx][:, None], c23[idx][:, None], c33[idx][:, None],
+            g.rx, g.ry, g.rz, w,
+        )
 
         vx_ji = vx[g.nj] - vx[idx][:, None]
         vy_ji = vy[g.nj] - vy[idx][:, None]
@@ -169,9 +171,11 @@ def compute_av_switches(
         )
         vijsignal = jnp.maximum(mmax(g.mask, vijsignal_pair), 1e-40 * c[idx])
 
-        tA1 = -(c11[idx][:, None] * g.rx + c12[idx][:, None] * g.ry + c13[idx][:, None] * g.rz) * w
-        tA2 = -(c12[idx][:, None] * g.rx + c22[idx][:, None] * g.ry + c23[idx][:, None] * g.rz) * w
-        tA3 = -(c13[idx][:, None] * g.rx + c23[idx][:, None] * g.ry + c33[idx][:, None] * g.rz) * w
+        tA1, tA2, tA3 = iad_project(
+            c11[idx][:, None], c12[idx][:, None], c13[idx][:, None],
+            c22[idx][:, None], c23[idx][:, None], c33[idx][:, None],
+            g.rx, g.ry, g.rz, w,
+        )
 
         vol_j = xm[g.nj] / kx[g.nj]
         factor = vol_j * (divv[idx][:, None] - divv[g.nj])
@@ -263,12 +267,15 @@ def compute_momentum_energy_ve(
         vijsignal = 0.5 * (c_i + c_j) - 2.0 * w_ij
         maxvsignal = mmax(g.mask, vijsignal)
 
-        tA1_i = -(c11[idx][:, None] * g.rx + c12[idx][:, None] * g.ry + c13[idx][:, None] * g.rz) * w_i
-        tA2_i = -(c12[idx][:, None] * g.rx + c22[idx][:, None] * g.ry + c23[idx][:, None] * g.rz) * w_i
-        tA3_i = -(c13[idx][:, None] * g.rx + c23[idx][:, None] * g.ry + c33[idx][:, None] * g.rz) * w_i
-        tA1_j = -(c11[g.nj] * g.rx + c12[g.nj] * g.ry + c13[g.nj] * g.rz) * w_j
-        tA2_j = -(c12[g.nj] * g.rx + c22[g.nj] * g.ry + c23[g.nj] * g.rz) * w_j
-        tA3_j = -(c13[g.nj] * g.rx + c23[g.nj] * g.ry + c33[g.nj] * g.rz) * w_j
+        tA1_i, tA2_i, tA3_i = iad_project(
+            c11[idx][:, None], c12[idx][:, None], c13[idx][:, None],
+            c22[idx][:, None], c23[idx][:, None], c33[idx][:, None],
+            g.rx, g.ry, g.rz, w_i,
+        )
+        tA1_j, tA2_j, tA3_j = iad_project(
+            c11[g.nj], c12[g.nj], c13[g.nj], c22[g.nj], c23[g.nj], c33[g.nj],
+            g.rx, g.ry, g.rz, w_j,
+        )
 
         m_i = m[idx][:, None]
         m_j = m[g.nj]

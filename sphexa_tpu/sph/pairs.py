@@ -39,6 +39,24 @@ def pair_geometry(idx, x, y, z, h, nidx, nmask, box: Box) -> PairGeom:
     return PairGeom(idx, nj, mask, rx, ry, rz, dist, v1)
 
 
+def iad_project(c11, c12, c13, c22, c23, c33, rx, ry, rz, w=None, sign=-1.0):
+    """Project the pair displacement through the symmetric IAD tensor:
+    tA_k = sign * (C r)_k * w. The same expression appears in every kernel
+    consuming the IAD (iad_divv_curlv, av_switches, momentum_energy std/ve);
+    keeping it in one place keeps the index pattern consistent.
+
+    c* may be i-side columns of shape (B, 1) or j-side gathers (B, ngmax).
+    """
+    t1 = c11 * rx + c12 * ry + c13 * rz
+    t2 = c12 * rx + c22 * ry + c23 * rz
+    t3 = c13 * rx + c23 * ry + c33 * rz
+    if w is not None:
+        t1, t2, t3 = t1 * w, t2 * w, t3 * w
+    if sign != 1.0:
+        t1, t2, t3 = sign * t1, sign * t2, sign * t3
+    return t1, t2, t3
+
+
 def msum(mask, terms):
     """Masked j-sum: zero out invalid pairs, reduce over the neighbor axis."""
     return jnp.sum(jnp.where(mask, terms, 0.0), axis=-1)
