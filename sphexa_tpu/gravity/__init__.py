@@ -1,0 +1,21 @@
+from sphexa_tpu.gravity.tree import (
+    GravityTree,
+    GravityTreeMeta,
+    build_gravity_tree,
+)
+from sphexa_tpu.gravity.traversal import (
+    GravityConfig,
+    compute_gravity,
+    estimate_gravity_caps,
+)
+from sphexa_tpu.gravity.direct import direct_gravity
+
+__all__ = [
+    "GravityTree",
+    "GravityTreeMeta",
+    "build_gravity_tree",
+    "GravityConfig",
+    "compute_gravity",
+    "estimate_gravity_caps",
+    "direct_gravity",
+]

@@ -1,0 +1,39 @@
+"""O(N^2) direct-sum gravity, the accuracy reference for the tree solver.
+
+Counterpart of ryoanji's directSum (ryoanji/src/ryoanji/nbody/direct.cuh):
+all-pairs softened interactions, used only by tests and accuracy checks.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.gravity import multipole as mp
+
+
+@functools.partial(jax.jit, static_argnames=("G",))
+def direct_gravity(x, y, z, m, h, G: float = 1.0):
+    """Returns (ax, ay, az, egrav) by summing every pair exactly.
+
+    Uses the same h_i+h_j clamped softening as the tree P2P so the two
+    solvers agree in the near field.
+    """
+    n = x.shape[0]
+    block = min(n, 1024)
+    num_blocks = -(-n // block)
+    idx = jnp.minimum(
+        jnp.arange(num_blocks * block, dtype=jnp.int32), n - 1
+    ).reshape(num_blocks, block)
+
+    def one_block(bi):
+        mask = jnp.arange(n, dtype=jnp.int32)[None, :] != bi[:, None]
+        return mp.p2p(x[bi], y[bi], z[bi], h[bi], x, y, z, m, h, mask)
+
+    ax, ay, az, phi = jax.lax.map(one_block, idx)
+    ax = ax.reshape(-1)[:n] * G
+    ay = ay.reshape(-1)[:n] * G
+    az = az.reshape(-1)[:n] * G
+    phi = phi.reshape(-1)[:n] * G
+    egrav = 0.5 * jnp.sum(m * phi)
+    return ax, ay, az, egrav

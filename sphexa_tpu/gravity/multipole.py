@@ -1,0 +1,126 @@
+"""Cartesian quadrupole operators: P2M / M2M / M2P / P2P.
+
+TPU-native re-design of the reference's ryoanji kernels
+(ryoanji/src/ryoanji/nbody/cartesian_qpole.hpp: P2M :89, addQuadrupole/M2M
+:210, M2P :177; kernel.hpp P2P :515): the per-node scalar loops become
+vectorized segment reductions and batched elementwise math over a
+level-major node array.
+
+Multipole layout: a (..., 7) array [qxx qxy qxz qyy qyz qzz trace] in the
+*trace-free* Hernquist-1987 form (qxx = 3<m dx dx> - trace, ...). Masses
+and centers-of-mass are carried separately (they are needed before the
+quadrupole pass).
+"""
+
+import jax.numpy as jnp
+from jax.ops import segment_sum
+
+
+def p2m_leaf(x, y, z, m, pleaf, leaf_com, num_leaves):
+    """Trace-free quadrupole of every leaf around its center of mass.
+
+    Vectorized counterpart of P2M (cartesian_qpole.hpp:89): raw second
+    moments via one segment-sum per component, then the trace removal.
+    """
+    dx = x - leaf_com[pleaf, 0]
+    dy = y - leaf_com[pleaf, 1]
+    dz = z - leaf_com[pleaf, 2]
+    raw = jnp.stack(
+        [m * dx * dx, m * dx * dy, m * dx * dz,
+         m * dy * dy, m * dy * dz, m * dz * dz],
+        axis=1,
+    )
+    q = segment_sum(raw, pleaf, num_segments=num_leaves)  # (L, 6)
+    return _remove_trace(q)
+
+
+def _remove_trace(q):
+    """raw second moments (..., 6) -> trace-free form (..., 7)."""
+    trace = q[..., 0] + q[..., 3] + q[..., 5]
+    return jnp.stack(
+        [3.0 * q[..., 0] - trace, 3.0 * q[..., 1], 3.0 * q[..., 2],
+         3.0 * q[..., 3] - trace, 3.0 * q[..., 4], 3.0 * q[..., 5] - trace,
+         trace],
+        axis=-1,
+    )
+
+
+def m2m_shift(q_child, m_child, d):
+    """Child quadrupole shifted to the parent expansion center.
+
+    addQuadrupole (cartesian_qpole.hpp:210), Hernquist 1987 eq. (2.5):
+    ``d = com_parent - com_child``; the returned term is scatter-added into
+    the parent.
+    """
+    dx, dy, dz = d[..., 0], d[..., 1], d[..., 2]
+    r2_3 = (dx * dx + dy * dy + dz * dz) * (1.0 / 3.0)
+    ml = 3.0 * m_child
+    return q_child + jnp.stack(
+        [ml * (dx * dx - r2_3), ml * dx * dy, ml * dx * dz,
+         ml * (dy * dy - r2_3), ml * dy * dz, ml * (dz * dz - r2_3),
+         ml * r2_3],
+        axis=-1,
+    )
+
+
+def m2p(tx, ty, tz, com, q, mass, mask):
+    """Far-field contribution of nodes to target particles.
+
+    M2P (cartesian_qpole.hpp:177), Hernquist 1987: monopole -M/r^3 * r plus
+    quadrupole Q.r/r^5 - 5/2 (r.Q.r) r / r^7. Shapes: targets (B,), nodes
+    (K,); returns per-target sums (ax, ay, az, phi) each (B,).
+    """
+    rx = tx[:, None] - com[None, :, 0]  # (B, K)
+    ry = ty[:, None] - com[None, :, 1]
+    rz = tz[:, None] - com[None, :, 2]
+    r2 = rx * rx + ry * ry + rz * rz
+    inv_r = jnp.where(mask[None, :], jnp.maximum(r2, 1e-30) ** -0.5, 0.0)
+    inv_r2 = inv_r * inv_r
+    inv_r5 = inv_r2 * inv_r2 * inv_r
+
+    qxx, qxy, qxz = q[:, 0], q[:, 1], q[:, 2]
+    qyy, qyz, qzz = q[:, 3], q[:, 4], q[:, 5]
+    qrx = rx * qxx[None] + ry * qxy[None] + rz * qxz[None]
+    qry = rx * qxy[None] + ry * qyy[None] + rz * qyz[None]
+    qrz = rx * qxz[None] + ry * qyz[None] + rz * qzz[None]
+    rqr = rx * qrx + ry * qry + rz * qrz
+
+    m_ = mass[None, :]
+    quad_mono = (-2.5 * rqr * inv_r5 - m_ * inv_r) * inv_r2
+    phi = -(m_ * inv_r + 0.5 * inv_r5 * rqr)
+    ax = inv_r5 * qrx + quad_mono * rx
+    ay = inv_r5 * qry + quad_mono * ry
+    az = inv_r5 * qrz + quad_mono * rz
+    valid = mask[None, :]
+    return (
+        jnp.sum(jnp.where(valid, ax, 0.0), axis=1),
+        jnp.sum(jnp.where(valid, ay, 0.0), axis=1),
+        jnp.sum(jnp.where(valid, az, 0.0), axis=1),
+        jnp.sum(jnp.where(valid, phi, 0.0), axis=1),
+    )
+
+
+def p2p(tx, ty, tz, th, sx, sy, sz, sm, sh, mask):
+    """Near-field particle-particle interaction, SPH-compatible softening.
+
+    P2P (ryoanji/nbody/kernel.hpp:515): inside the combined smoothing
+    length ``h_i + h_j`` the effective distance is clamped to it, which
+    makes the force vanish linearly at r -> 0 (matching the reference's
+    choice, not a Plummer profile). Shapes: targets (B,), sources (S,);
+    returns (ax, ay, az, phi) each (B,).
+    """
+    dx = sx[None, :] - tx[:, None]  # (B, S), source minus target
+    dy = sy[None, :] - ty[:, None]
+    dz = sz[None, :] - tz[:, None]
+    r2 = dx * dx + dy * dy + dz * dz
+    h_ij = th[:, None] + sh[None, :]
+    r2_eff = jnp.maximum(r2, h_ij * h_ij)
+    inv_r = jnp.where(mask, jnp.maximum(r2_eff, 1e-30) ** -0.5, 0.0)
+    inv_r3m = sm[None, :] * inv_r * inv_r * inv_r
+    phi = -inv_r3m * r2
+    return (
+        jnp.sum(dx * inv_r3m, axis=1),
+        jnp.sum(dy * inv_r3m, axis=1),
+        jnp.sum(dz * inv_r3m, axis=1),
+        jnp.sum(phi, axis=1),
+    )

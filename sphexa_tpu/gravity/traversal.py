@@ -1,0 +1,274 @@
+"""Traversal-free Barnes-Hut gravity: batched MAC + fixed-cap interaction lists.
+
+TPU-native re-design of ryoanji's warp-centric dual traversal
+(ryoanji/src/ryoanji/nbody/traversal.cuh:60-79 TravConfig,
+traversal_cpu.hpp:84 computeGravityGroup). Instead of a stack/ring-buffer
+walk, every target group evaluates the vector MAC against *all* tree nodes
+at once (the node array is small, ~N/bucket), then classifies each node by
+the classic first-accepted-ancestor rule:
+
+- M2P set: node passes the MAC and no ancestor passed it;
+- P2P set: node is a leaf, and neither it nor any ancestor passed.
+
+The ancestor predicate is a level-by-level downsweep (gather from parent),
+and the sparse sets are compacted into fixed-cap index lists via a stable
+argsort — overflow is reported as a diagnostic, standing in for the
+reference's traversal stack-overflow detection (gravity_wrapper.hpp:120).
+
+Target groups are fixed blocks of SFC-consecutive particles (the analog of
+TravConfig's 64-particle targets), so all shapes are static. Work is
+chunked with lax.map (sequential) over groups of blocks, with vmap inside,
+to bound transient memory.
+
+Softening/energy conventions follow the reference exactly: P2P clamps the
+distance to h_i+h_j (kernel.hpp:515), egrav = 0.5*G*sum(m_i*phi_i)
+(traversal_cpu.hpp:231).
+"""
+
+import dataclasses
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sphexa_tpu.gravity import multipole as mp
+from sphexa_tpu.gravity.tree import GravityTree, GravityTreeMeta
+from sphexa_tpu.sfc.box import Box
+
+
+@dataclasses.dataclass(frozen=True)
+class GravityConfig:
+    """Static gravity-solver configuration (hashable, jit-safe)."""
+
+    theta: float = 0.5  # opening angle; accept if dist > 2*size/theta + com offset
+    bucket_size: int = 64  # leaf capacity target for the gravity tree build
+    target_block: int = 64  # particles per MAC target group (TravConfig analog)
+    blocks_per_chunk: int = 32  # target groups processed per lax.map step
+    m2p_cap: int = 512  # max accepted multipoles per target group
+    p2p_cap: int = 48  # max near-field leaves per target group
+    leaf_cap: int = 128  # max particles gathered per near-field leaf
+    G: float = 1.0
+
+
+def estimate_gravity_caps(
+    x, y, z, m, sorted_keys, box: Box,
+    tree: GravityTree, meta: GravityTreeMeta, cfg: GravityConfig,
+    sample_blocks: int = 256, margin: float = 1.5, quantum: int = 32,
+) -> GravityConfig:
+    """Size the interaction-list caps from the current distribution.
+
+    Host-side helper run at (re)configuration time, the gravity analog of
+    estimate_cell_cap: simulate the MAC classification for a sample of
+    target blocks in numpy and pad the observed maxima. The caps are upper
+    bounds by sampling only — the overflow diagnostics returned by
+    compute_gravity remain the correctness guard.
+    """
+    node_mass, node_com, node_q, edges = compute_multipoles(
+        x, y, z, m, sorted_keys, tree, meta
+    )
+    nm = np.asarray(node_mass)
+    com = np.asarray(node_com)
+    edges = np.asarray(edges)
+    valid = nm > 0.0
+    parent = np.asarray(tree.parent)
+    is_leaf = np.asarray(tree.is_leaf)
+    leaf_of_node = np.asarray(tree.leaf_of_node)
+    counts = np.diff(edges)
+
+    lengths = np.asarray(box.lengths)
+    lo = np.asarray([box.lo[0], box.lo[1], box.lo[2]], dtype=np.float64)
+    geo_center = lo[None, :] + np.asarray(tree.center_frac) * lengths[None, :]
+    geo_size = np.asarray(tree.halfsize_frac)[:, None] * lengths[None, :]
+    l_node = 2.0 * geo_size.max(axis=1)
+    s_off = np.linalg.norm(com - geo_center, axis=1)
+    mac2 = (l_node / cfg.theta + s_off) ** 2
+
+    xa, ya, za = np.asarray(x), np.asarray(y), np.asarray(z)
+    n = len(xa)
+    blk = cfg.target_block
+    nb = -(-n // blk)
+    rng = np.random.default_rng(0)
+    blocks = (
+        np.arange(nb)
+        if nb <= sample_blocks
+        else np.unique(np.concatenate([[0, nb - 1], rng.integers(0, nb, sample_blocks)]))
+    )
+
+    m2p_max, p2p_max = 1, 1
+    for b in blocks:
+        sl = slice(b * blk, min((b + 1) * blk, n))
+        pmin = np.array([xa[sl].min(), ya[sl].min(), za[sl].min()])
+        pmax = np.array([xa[sl].max(), ya[sl].max(), za[sl].max()])
+        bc, bs = (pmax + pmin) / 2, (pmax - pmin) / 2
+        d = np.maximum(np.abs(bc[None, :] - com) - bs[None, :], 0.0)
+        accept = valid & ~((d * d).sum(axis=1) < mac2)
+        anc = np.zeros(meta.num_nodes, dtype=bool)
+        for s, e in meta.level_ranges[1:]:
+            anc[s:e] = anc[parent[s:e]] | accept[parent[s:e]]
+        m2p_max = max(m2p_max, int((accept & ~anc).sum()))
+        p2p_max = max(p2p_max, int((is_leaf & valid & ~accept & ~anc).sum()))
+    del leaf_of_node
+
+    def pad(v):
+        return int(np.ceil(v * margin / quantum) * quantum)
+
+    leaf_cap = pad(int(counts.max()) if len(counts) else 1)
+    return dataclasses.replace(
+        cfg,
+        m2p_cap=min(pad(m2p_max), meta.num_nodes),
+        p2p_cap=min(pad(p2p_max), meta.num_leaves),
+        leaf_cap=leaf_cap,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("meta",))
+def compute_multipoles(
+    x, y, z, m, sorted_keys, tree: GravityTree, meta: GravityTreeMeta
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Masses, centers of mass and quadrupoles for every tree node.
+
+    Device-side counterpart of computeLeafMultipoles + upsweepMultipoles
+    (ryoanji/nbody/upsweep_cpu.hpp:26-92): leaf payload via segment sums
+    over the particle->leaf assignment, then a level-by-level scatter-add
+    upsweep with the M2M expansion-center shift.
+
+    Returns (node_mass (N,), node_com (N,3), node_q (N,7), edges (L+1,)).
+    """
+    lk = tree.leaf_keys
+    num_l, num_n = meta.num_leaves, meta.num_nodes
+    edges = jnp.searchsorted(sorted_keys, lk, side="left").astype(jnp.int32)
+    pleaf = (
+        jnp.searchsorted(lk, sorted_keys, side="right").astype(jnp.int32) - 1
+    )
+
+    # pass 1: monopole + center of mass, leaves then upsweep. Processing
+    # levels deepest-first means a node's own subtree sum is complete by the
+    # time it is added to its parent.
+    w = jnp.stack([m, m * x, m * y, m * z], axis=1)  # (n, 4)
+    leaf_w = jax.ops.segment_sum(w, pleaf, num_segments=num_l)  # (L, 4)
+    node_w = jnp.zeros((num_n, 4), leaf_w.dtype).at[tree.node_of_leaf].set(leaf_w)
+    for s, e in reversed(meta.level_ranges[1:]):
+        node_w = node_w.at[tree.parent[s:e]].add(node_w[s:e])
+    node_mass = node_w[:, 0]
+    node_com = node_w[:, 1:4] / jnp.maximum(node_mass, 1e-30)[:, None]
+
+    # pass 2: leaf quadrupoles around the leaf com, then M2M upsweep with
+    # the expansion-center shift to the parent com
+    leaf_com = node_com[tree.node_of_leaf]
+    leaf_q = mp.p2m_leaf(x, y, z, m, pleaf, leaf_com, num_l)  # (L, 7)
+    node_q = jnp.zeros((num_n, 7), leaf_q.dtype).at[tree.node_of_leaf].set(leaf_q)
+    for s, e in reversed(meta.level_ranges[1:]):
+        par = tree.parent[s:e]
+        d = node_com[par] - node_com[s:e]
+        node_q = node_q.at[par].add(mp.m2m_shift(node_q[s:e], node_mass[s:e], d))
+    return node_mass, node_com, node_q, edges
+
+
+@functools.partial(jax.jit, static_argnames=("meta", "cfg"))
+def compute_gravity(
+    x, y, z, m, h, sorted_keys, box: Box,
+    tree: GravityTree, meta: GravityTreeMeta, cfg: GravityConfig,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Gravitational acceleration + potential for all (SFC-sorted) particles.
+
+    Returns (ax, ay, az, egrav, diagnostics). Diagnostics report the
+    high-water interaction-list occupancies; if any exceeds its cap the
+    caller must enlarge the config and re-run (Simulation handles this the
+    same way as neighbor-cell overflow).
+    """
+    n = x.shape[0]
+    num_n = meta.num_nodes
+    node_mass, node_com, node_q, edges = compute_multipoles(
+        x, y, z, m, sorted_keys, tree, meta
+    )
+    valid = node_mass > 0.0
+
+    lengths = box.lengths  # (3,)
+    lo = jnp.stack([box.lo[0], box.lo[1], box.lo[2]])
+    geo_center = lo[None, :] + tree.center_frac * lengths[None, :]  # (N, 3)
+    geo_size = tree.halfsize_frac[:, None] * lengths[None, :]  # (N, 3)
+    # vector MAC acceptance radius around the expansion center
+    # (macs.hpp computeVecMacR2: l = 2*max(geoSize), mac = l/theta + |com - geo|)
+    l_node = 2.0 * jnp.max(geo_size, axis=1)
+    s_off = jnp.sqrt(jnp.sum((node_com - geo_center) ** 2, axis=1))
+    mac2 = (l_node / cfg.theta + s_off) ** 2  # (N,)
+
+    blk = cfg.target_block
+    num_blocks = -(-n // blk)
+    chunk = cfg.blocks_per_chunk
+    num_chunks = -(-num_blocks // chunk)
+    idx = jnp.arange(num_chunks * chunk * blk, dtype=jnp.int32)
+    idx = jnp.minimum(idx, n - 1).reshape(num_chunks, chunk, blk)
+
+    leaf_occ = jnp.max(edges[1:] - edges[:-1])
+
+    def one_block(bi):
+        """bi: (blk,) particle indices of one target group."""
+        tx, ty, tz, th = x[bi], y[bi], z[bi], h[bi]
+        bc = jnp.stack(
+            [(jnp.max(tx) + jnp.min(tx)) * 0.5,
+             (jnp.max(ty) + jnp.min(ty)) * 0.5,
+             (jnp.max(tz) + jnp.min(tz)) * 0.5]
+        )
+        bs = jnp.stack(
+            [(jnp.max(tx) - jnp.min(tx)) * 0.5,
+             (jnp.max(ty) - jnp.min(ty)) * 0.5,
+             (jnp.max(tz) - jnp.min(tz)) * 0.5]
+        )
+        # evaluateMac (macs.hpp): distance from target box to expansion center
+        d = jnp.maximum(jnp.abs(bc[None, :] - node_com) - bs[None, :], 0.0)
+        mac_fail = jnp.sum(d * d, axis=1) < mac2  # too close: must open
+        accept = valid & ~mac_fail  # (N,)
+
+        # first-accepted-ancestor downsweep
+        anc = jnp.zeros(num_n, dtype=bool)
+        for s, e in meta.level_ranges[1:]:
+            par = tree.parent[s:e]
+            anc = anc.at[s:e].set(anc[par] | accept[par])
+
+        m2p_mask = accept & ~anc
+        p2p_mask = tree.is_leaf & valid & ~accept & ~anc
+        m2p_n = jnp.sum(m2p_mask)
+        p2p_n = jnp.sum(p2p_mask)
+
+        order = jnp.argsort(~m2p_mask, stable=True)[: cfg.m2p_cap]
+        m2p_ok = m2p_mask[order]
+        ax, ay, az, phi = mp.m2p(
+            tx, ty, tz, node_com[order], node_q[order], node_mass[order], m2p_ok
+        )
+
+        order_p = jnp.argsort(~p2p_mask, stable=True)[: cfg.p2p_cap]
+        p2p_ok = p2p_mask[order_p]
+        lidx = tree.leaf_of_node[order_p]  # (P,)
+        start = edges[lidx]
+        end = edges[lidx + 1]
+        cand = start[:, None] + jnp.arange(cfg.leaf_cap, dtype=jnp.int32)
+        cand_ok = (cand < end[:, None]) & p2p_ok[:, None]
+        cand = jnp.clip(cand, 0, n - 1).reshape(-1)  # (P*C,)
+        cand_ok = cand_ok.reshape(-1)
+        pair_ok = cand_ok[None, :] & (cand[None, :] != bi[:, None])
+        pax, pay, paz, pphi = mp.p2p(
+            tx, ty, tz, th,
+            x[cand], y[cand], z[cand], m[cand], h[cand], pair_ok,
+        )
+        return ax + pax, ay + pay, az + paz, phi + pphi, m2p_n, p2p_n
+
+    def one_chunk(bidx):
+        return jax.vmap(one_block)(bidx)
+
+    ax, ay, az, phi, m2p_n, p2p_n = jax.lax.map(one_chunk, idx)
+    ax = ax.reshape(-1)[:n] * cfg.G
+    ay = ay.reshape(-1)[:n] * cfg.G
+    az = az.reshape(-1)[:n] * cfg.G
+    phi = phi.reshape(-1)[:n] * cfg.G
+    # padded tail lanes duplicate the last particle; only [:n] is kept, and
+    # egrav sums the trimmed arrays, so duplicates never double-count.
+    egrav = 0.5 * jnp.sum(m * phi)
+    diagnostics = {
+        "m2p_max": jnp.max(m2p_n),
+        "p2p_max": jnp.max(p2p_n),
+        "leaf_occ": leaf_occ,
+    }
+    return ax, ay, az, egrav, diagnostics

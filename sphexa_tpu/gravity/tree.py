@@ -1,0 +1,136 @@
+"""Host-side linked octree construction for the gravity solver.
+
+TPU-native counterpart of the reference's internal-octree linkage
+(cstone/tree/octree.hpp:132 linkTreeCpu: prefixes, childOffsets, parents,
+levelRange). Instead of child offsets + explicit traversal, the structure
+here is a *level-major node array* with a parent index per node — exactly
+what a vectorized upsweep (scatter-add child->parent per level) and a
+batched downsweep (gather parent->child per level) need.
+
+The build runs on host (numpy) at configuration granularity, like the
+cell-list grid: node *structure* is static between reconfigurations while
+all node *payload* (masses, centers-of-mass, multipoles) is recomputed on
+device every step from the current particle arrays, so a stale structure
+costs only balance, never correctness (leaf occupancy overflow is guarded
+by a diagnostic, mirroring the reference's GPU stack-overflow detection,
+gravity_wrapper.hpp:120).
+
+Node geometry is stored as box-relative fractions so the traced Box can
+grow (open boundaries) without invalidating the host structure.
+"""
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sphexa_tpu.dtypes import KEY_BITS
+from sphexa_tpu.sfc.hilbert import hilbert_decode
+from sphexa_tpu.sfc.morton import morton_decode
+from sphexa_tpu.tree.csarray import KEY_RANGE, compute_octree, node_levels
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GravityTree:
+    """Device arrays describing the linked octree (level-major node order)."""
+
+    leaf_keys: jax.Array  # (L+1,) uint32 cornerstone leaf boundaries
+    parent: jax.Array  # (N,) int32, parent node index (root: 0)
+    is_leaf: jax.Array  # (N,) bool
+    leaf_of_node: jax.Array  # (N,) int32 leaf index, or 0 for internal (mask!)
+    node_of_leaf: jax.Array  # (L,) int32
+    center_frac: jax.Array  # (N, 3) float32 box-relative geometric center
+    halfsize_frac: jax.Array  # (N,) float32 box-relative half edge length
+
+
+@dataclasses.dataclass(frozen=True)
+class GravityTreeMeta:
+    """Static (hashable) structure metadata selecting the compiled code."""
+
+    num_leaves: int
+    num_nodes: int
+    # (start, end) node-index range per level, root level first
+    level_ranges: Tuple[Tuple[int, int], ...]
+
+
+def build_gravity_tree(
+    sorted_keys, bucket_size: int, curve: str = "hilbert"
+) -> Tuple[GravityTree, GravityTreeMeta]:
+    """Build the cornerstone leaf array + internal linkage from host keys.
+
+    Counterpart of computeOctree (csarray.hpp:456) followed by
+    updateInternalTree (octree.hpp). SFC octants are cubes at every level
+    for both Morton and Hilbert curves, so a node's geometry follows from
+    decoding its range-start key and truncating to its level.
+    """
+    keys = np.asarray(sorted_keys, dtype=np.uint64)
+    leaf_tree, _counts = compute_octree(keys, bucket_size)
+    leaf_levels = node_levels(leaf_tree)
+    leaf_starts = leaf_tree[:-1]
+    num_leaves = len(leaf_starts)
+    max_level = int(leaf_levels.max()) if num_leaves > 1 else 0
+
+    # node set per level: leaves at that level + ancestors of deeper leaves
+    per_level = []
+    for lvl in range(max_level + 1):
+        span = KEY_RANGE >> np.uint64(3 * lvl)
+        here = leaf_starts[leaf_levels == lvl]
+        deeper = leaf_starts[leaf_levels > lvl]
+        anc = np.unique((deeper // span) * span) if len(deeper) else deeper
+        per_level.append(np.unique(np.concatenate([here, anc])))
+
+    level_offsets = np.concatenate([[0], np.cumsum([len(p) for p in per_level])])
+    num_nodes = int(level_offsets[-1])
+    node_key = np.concatenate(per_level)
+    node_level = np.concatenate(
+        [np.full(len(p), lvl, dtype=np.int64) for lvl, p in enumerate(per_level)]
+    )
+
+    # parent: truncate key to the parent level's span, binary-search that level
+    parent = np.zeros(num_nodes, dtype=np.int32)
+    for lvl in range(1, max_level + 1):
+        s, e = level_offsets[lvl], level_offsets[lvl + 1]
+        pspan = KEY_RANGE >> np.uint64(3 * (lvl - 1))
+        pkeys = (node_key[s:e] // pspan) * pspan
+        pos = np.searchsorted(per_level[lvl - 1], pkeys)
+        parent[s:e] = level_offsets[lvl - 1] + pos
+
+    # leaf identification: a node is the leaf with the same start iff levels match
+    leaf_pos = np.searchsorted(leaf_starts, node_key)
+    leaf_pos = np.clip(leaf_pos, 0, num_leaves - 1)
+    is_leaf = (leaf_starts[leaf_pos] == node_key) & (leaf_levels[leaf_pos] == node_level)
+    leaf_of_node = np.where(is_leaf, leaf_pos, 0).astype(np.int32)
+    node_of_leaf = np.zeros(num_leaves, dtype=np.int32)
+    node_of_leaf[leaf_of_node[is_leaf]] = np.flatnonzero(is_leaf)
+
+    # geometry: decode range-start key at full depth, truncate to node level
+    decode = hilbert_decode if curve == "hilbert" else morton_decode
+    ix, iy, iz = decode(jnp.asarray(node_key.astype(np.uint32)))
+    cells = np.stack([np.asarray(ix), np.asarray(iy), np.asarray(iz)], axis=1)
+    shift = (KEY_BITS - node_level)[:, None]
+    octant = cells >> shift
+    inv = 1.0 / (1 << node_level).astype(np.float64)
+    center_frac = ((octant + 0.5) * inv[:, None]).astype(np.float32)
+    halfsize_frac = (0.5 * inv).astype(np.float32)
+
+    tree = GravityTree(
+        leaf_keys=jnp.asarray(leaf_tree.astype(np.uint32)),
+        parent=jnp.asarray(parent),
+        is_leaf=jnp.asarray(is_leaf),
+        leaf_of_node=jnp.asarray(leaf_of_node),
+        node_of_leaf=jnp.asarray(node_of_leaf),
+        center_frac=jnp.asarray(center_frac),
+        halfsize_frac=jnp.asarray(halfsize_frac),
+    )
+    meta = GravityTreeMeta(
+        num_leaves=num_leaves,
+        num_nodes=num_nodes,
+        level_ranges=tuple(
+            (int(level_offsets[l]), int(level_offsets[l + 1]))
+            for l in range(max_level + 1)
+        ),
+    )
+    return tree, meta
