@@ -74,7 +74,6 @@ def estimate_gravity_caps(
     valid = nm > 0.0
     parent = np.asarray(tree.parent)
     is_leaf = np.asarray(tree.is_leaf)
-    leaf_of_node = np.asarray(tree.leaf_of_node)
     counts = np.diff(edges)
 
     lengths = np.asarray(box.lengths)
@@ -109,7 +108,6 @@ def estimate_gravity_caps(
             anc[s:e] = anc[parent[s:e]] | accept[parent[s:e]]
         m2p_max = max(m2p_max, int((accept & ~anc).sum()))
         p2p_max = max(p2p_max, int((is_leaf & valid & ~accept & ~anc).sum()))
-    del leaf_of_node
 
     def pad(v):
         return int(np.ceil(v * margin / quantum) * quantum)

@@ -57,11 +57,16 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std)
     lower to halo collectives, and jnp.min/sum reductions become pmin/psum
     (the reference's MPI_Allreduce at timestep.hpp:106 and
     conserved_quantities.hpp:118).
+
+    When ``cfg.gravity`` is set, the returned stepper takes the gravity
+    tree as a third argument: ``stepper(state, box, gtree)``; the (small)
+    tree arrays stay replicated across the mesh, matching the reference's
+    replicated global octree (assignment.hpp:51-53).
     """
     pspec = NamedSharding(mesh, P("p"))
 
-    def stepper(s, b):
-        new_state, new_box, diag = step_fn(s, b, cfg)
+    def stepper(s, b, gtree=None):
+        new_state, new_box, diag = step_fn(s, b, cfg, gtree)
         # keep the particle arrays sharded on the way out so the next step
         # starts from slab-owned arrays (no silent replication creep)
         constrain = lambda l: (

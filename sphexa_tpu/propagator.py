@@ -14,11 +14,13 @@ sees the complete dataflow and can fuse/schedule across op boundaries.
 
 import dataclasses
 import functools
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from sphexa_tpu.gravity.traversal import GravityConfig, compute_gravity
+from sphexa_tpu.gravity.tree import GravityTree, GravityTreeMeta
 from sphexa_tpu.neighbors.cell_list import NeighborConfig, find_neighbors
 from sphexa_tpu.sfc.box import Box, make_global_box
 from sphexa_tpu.sfc.keys import compute_sfc_keys
@@ -26,18 +28,31 @@ from sphexa_tpu.sph import hydro_std, hydro_ve
 from sphexa_tpu.sph.kernels import update_h
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
 from sphexa_tpu.sph.positions import compute_positions
-from sphexa_tpu.sph.timestep import compute_timestep, rho_timestep
+from sphexa_tpu.sph.timestep import (
+    acceleration_timestep,
+    compute_timestep,
+    rho_timestep,
+)
 
 
 @dataclasses.dataclass(frozen=True)
 class PropagatorConfig:
-    """Static per-run configuration: physics constants + neighbor search."""
+    """Static per-run configuration: physics constants + neighbor search.
+
+    When self-gravity is on (const.g != 0), ``gravity`` holds the static
+    solver caps and ``grav_meta`` the (hashable) tree-structure metadata;
+    the matching GravityTree arrays are passed to the step function as a
+    pytree argument (the structure is host-rebuilt at reconfiguration
+    granularity, like the neighbor cell grid).
+    """
 
     const: SimConstants
     nbr: NeighborConfig
     curve: str = "hilbert"
     block: int = 2048
     av_clean: bool = False
+    gravity: Optional[GravityConfig] = None
+    grav_meta: Optional[GravityTreeMeta] = None
 
 
 def _sort_by_keys(state: ParticleState, box: Box, curve: str):
@@ -55,9 +70,28 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str):
     return jax.tree.map(maybe_gather, state), sorted_keys
 
 
+def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
+    """Self-gravity coupling: Barnes-Hut accel added to the hydro accel.
+
+    The analog of mHolder_.upsweep + traverse inside computeForces
+    (main/src/propagator/gravity_wrapper.hpp:97-123): runs on the
+    SFC-sorted arrays the step just produced. Returns updated accels,
+    egrav, the acceleration dt candidate, and solver diagnostics.
+    """
+    gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g)
+    gx, gy, gz, egrav, gdiag = compute_gravity(
+        state.x, state.y, state.z, state.m, state.h, keys, box,
+        gtree, cfg.grav_meta, gcfg,
+    )
+    ax, ay, az = ax + gx, ay + gy, az + gz
+    dt_acc = acceleration_timestep(ax, ay, az, cfg.const)
+    return ax, ay, az, egrav, dt_acc, gdiag
+
+
 def _integrate_and_finish(
     state: ParticleState, box: Box, const: SimConstants,
-    ax, ay, az, du, dt, nc, occ, rho, extra=None,
+    ax, ay, az, du, dt, nc, occ, rho, extra=None, extra_diag=None,
+    update_smoothing=True,
 ):
     """Shared step tail: drift/kick + PBC wrap, smoothing-length nudge,
     state rebuild, diagnostics. Every propagator's force stage funnels
@@ -68,7 +102,7 @@ def _integrate_and_finish(
     (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, du, du_m1) = compute_positions(
         fields, ax, ay, az, dt, state.min_dt, box, const
     )
-    new_h = update_h(const.ng0, nc + 1, h)
+    new_h = update_h(const.ng0, nc + 1, h) if update_smoothing else h
     new_state = dataclasses.replace(
         state,
         x=nx, y=ny, z=nz, x_m1=dxm, y_m1=dym, z_m1=dzm,
@@ -83,18 +117,20 @@ def _integrate_and_finish(
         "occupancy": occ,
         "rho_max": jnp.max(rho),
     }
+    diagnostics.update(extra_diag or {})
     return new_state, box, diagnostics
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def step_hydro_std(
-    state: ParticleState, box: Box, cfg: PropagatorConfig
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree] = None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
     """One standard-SPH time step (std_hydro.hpp:123-175 sequence).
 
     box regrow -> sort -> neighbors -> density -> EOS -> IAD ->
-    momentum/energy -> timestep -> positions -> smoothing-length update.
-    Returns (new_state, new_box, diagnostics).
+    momentum/energy [-> gravity] -> timestep -> positions ->
+    smoothing-length update. Returns (new_state, new_box, diagnostics).
     """
     const = cfg.const
     # grow open-boundary dims to fit drifted particles (box_mpi.hpp role);
@@ -115,13 +151,23 @@ def step_hydro_std(
         c11, c12, c13, c22, c23, c33, nidx, nmask, box, const, cfg.block,
     )
 
-    dt = compute_timestep(state.min_dt, dt_courant, const=const)
-    return _integrate_and_finish(state, box, const, ax, ay, az, du, dt, nc, occ, rho)
+    extra_dts, gdiag = (), None
+    if cfg.gravity is not None:
+        ax, ay, az, egrav, dt_acc, gdiag = _add_gravity(
+            state, box, keys, cfg, gtree, ax, ay, az
+        )
+        extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
+
+    dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=const)
+    return _integrate_and_finish(
+        state, box, const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def step_hydro_ve(
-    state: ParticleState, box: Box, cfg: PropagatorConfig
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree] = None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
     """One generalized-volume-element SPH time step.
 
@@ -174,8 +220,42 @@ def step_hydro_ve(
         nidx, nmask, nc, box, const, cfg.block, gradv=gradv,
     )
 
-    dt = compute_timestep(state.min_dt, dt_courant, dt_rho, const=const)
+    extra_dts, gdiag = (), None
+    if cfg.gravity is not None:
+        ax, ay, az, egrav, dt_acc, gdiag = _add_gravity(
+            state, box, keys, cfg, gtree, ax, ay, az
+        )
+        extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
+
+    dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts, const=const)
     return _integrate_and_finish(
         state, box, const, ax, ay, az, du, dt, nc, occ, rho,
-        extra={"alpha": alpha},
+        extra={"alpha": alpha}, extra_diag=gdiag,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def step_nbody(
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree] = None,
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
+    """One gravity-only N-body step (main/src/propagator/nbody.hpp:51-156).
+
+    sort -> multipole upsweep -> Barnes-Hut traversal -> acceleration
+    timestep -> position update. No hydro fields are touched (du = 0).
+    """
+    const = cfg.const
+    box = make_global_box(state.x, state.y, state.z, box)
+    state, keys = _sort_by_keys(state, box, cfg.curve)
+
+    zero = jnp.zeros_like(state.x)
+    ax, ay, az, egrav, dt_acc, gdiag = _add_gravity(
+        state, box, keys, cfg, gtree, zero, zero, zero
+    )
+    dt = compute_timestep(state.min_dt, dt_acc, const=const)
+
+    nc = jnp.zeros_like(state.x, dtype=jnp.int32)
+    return _integrate_and_finish(
+        state, box, const, ax, ay, az, zero, dt, nc, jnp.int32(0), zero,
+        extra_diag={**gdiag, "egrav": egrav}, update_smoothing=False,
     )

@@ -13,19 +13,27 @@ from typing import Callable, Dict, Optional
 import numpy as np
 import jax.numpy as jnp
 
+from sphexa_tpu.gravity.traversal import GravityConfig, estimate_gravity_caps
+from sphexa_tpu.gravity.tree import build_gravity_tree
 from sphexa_tpu.neighbors.cell_list import (
     NeighborConfig,
     choose_grid_level,
     estimate_cell_cap,
 )
-from sphexa_tpu.propagator import PropagatorConfig, step_hydro_std, step_hydro_ve
-from sphexa_tpu.sfc.box import Box
+from sphexa_tpu.propagator import (
+    PropagatorConfig,
+    step_hydro_std,
+    step_hydro_ve,
+    step_nbody,
+)
+from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sfc.keys import compute_sfc_keys
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
 
 _PROPAGATORS: Dict[str, Callable] = {
     "std": step_hydro_std,
     "ve": step_hydro_ve,
+    "nbody": step_nbody,
 }
 
 
@@ -69,6 +77,8 @@ class Simulation:
         block: int = 2048,
         curve: str = "hilbert",
         av_clean: bool = False,
+        theta: float = 0.5,
+        grav_bucket: int = 64,
     ):
         self.state = state
         self.box = box
@@ -78,22 +88,77 @@ class Simulation:
         self.curve = curve
         self.av_clean = av_clean
         self.ngmax = ngmax or const.ngmax
+        self.theta = theta
+        self.grav_bucket = grav_bucket
+        if prop == "nbody" and const.g == 0.0:
+            raise ValueError(
+                "prop='nbody' needs a gravitational constant: set SimConstants(g=...)"
+            )
+        self.gravity_on = const.g != 0.0
+        if self.gravity_on and any(
+            b == BoundaryType.periodic for b in box.boundaries
+        ):
+            raise NotImplementedError(
+                "self-gravity in a periodic box needs the Ewald solver "
+                "(traversal_ewald_cpu.hpp analog), which is not wired in yet; "
+                "use open boundaries"
+            )
         self.iteration = 0
         self._cfg: Optional[PropagatorConfig] = None
+        self._gtree = None
         self._configure()
 
     # -- static config management ------------------------------------------
-    def _configure(self, min_cap: int = 0):
+    def _configure(self, min_cap: int = 0, grav_margin: float = 1.5):
         self._cfg = make_propagator_config(
             self.state, self.box, self.const,
             ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
             av_clean=self.av_clean,
+        )
+        if self.gravity_on:
+            self._configure_gravity(grav_margin)
+
+    def _configure_gravity(self, margin: float):
+        """(Re)build the gravity tree structure from the current particle
+        distribution and size the interaction-list caps (the gravity analog
+        of re-sizing the neighbor cell grid — host work, reconfiguration
+        granularity only)."""
+        s = self.state
+        keys = np.asarray(compute_sfc_keys(s.x, s.y, s.z, self.box, curve=self.curve))
+        order = np.argsort(keys)
+        skeys = jnp.asarray(keys[order])
+        xs = jnp.asarray(np.asarray(s.x)[order])
+        ys = jnp.asarray(np.asarray(s.y)[order])
+        zs = jnp.asarray(np.asarray(s.z)[order])
+        ms = jnp.asarray(np.asarray(s.m)[order])
+        gtree, meta = build_gravity_tree(
+            keys[order], bucket_size=self.grav_bucket, curve=self.curve
+        )
+        gcfg = estimate_gravity_caps(
+            xs, ys, zs, ms, skeys, self.box, gtree, meta,
+            GravityConfig(theta=self.theta, bucket_size=self.grav_bucket,
+                          G=self.const.g),
+            margin=margin,
+        )
+        self._gtree = gtree
+        self._cfg = dataclasses.replace(self._cfg, gravity=gcfg, grav_meta=meta)
+
+    def _gravity_overflowed(self, diagnostics) -> bool:
+        if not self.gravity_on:
+            return False
+        g = self._cfg.gravity
+        return (
+            int(diagnostics["m2p_max"]) > g.m2p_cap
+            or int(diagnostics["p2p_max"]) > g.p2p_cap
+            or int(diagnostics["leaf_occ"]) > g.leaf_cap
         )
 
     def _config_still_valid(self, diagnostics) -> bool:
         nbr = self._cfg.nbr
         if int(diagnostics["occupancy"]) > nbr.cap:
             return False
+        if self.prop_name == "nbody":
+            return True
         h_max = float(jnp.max(self.state.h))
         cell_edge = float(np.min(np.asarray(self.box.lengths))) / (1 << nbr.level)
         return 2.0 * h_max <= cell_edge
@@ -105,14 +170,22 @@ class Simulation:
         under a freshly sized config — overflow must never corrupt state."""
         step_fn = _PROPAGATORS[self.prop_name]
         reconfigured = False
+        grav_margin = 1.5
         for _attempt in range(3):
-            new_state, new_box, diagnostics = step_fn(self.state, self.box, self._cfg)
-            if int(diagnostics["occupancy"]) <= self._cfg.nbr.cap:
+            new_state, new_box, diagnostics = step_fn(
+                self.state, self.box, self._cfg, self._gtree
+            )
+            nbr_over = int(diagnostics["occupancy"]) > self._cfg.nbr.cap
+            grav_over = self._gravity_overflowed(diagnostics)
+            if not nbr_over and not grav_over:
                 break
-            self._configure(min_cap=int(diagnostics["occupancy"]))
+            grav_margin *= 1.5 if grav_over else 1.0
+            self._configure(
+                min_cap=int(diagnostics["occupancy"]), grav_margin=grav_margin
+            )
             reconfigured = True
         else:
-            raise RuntimeError("neighbor cell cap failed to converge in 3 attempts")
+            raise RuntimeError("neighbor/gravity caps failed to converge in 3 attempts")
         self.state = new_state
         self.box = new_box
         self.iteration += 1

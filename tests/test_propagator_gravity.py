@@ -1,0 +1,114 @@
+"""Gravity-coupled propagator tests.
+
+Mirrors the reference's NbodyProp (main/src/propagator/nbody.hpp) usage:
+a Plummer sphere advanced by the gravity-only propagator must (a) produce
+step-0 accelerations matching direct summation and (b) conserve total
+energy over a few steps with the acceleration-limited timestep.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sphexa_tpu.gravity import direct_gravity
+from sphexa_tpu.sfc.box import Box
+from sphexa_tpu.simulation import Simulation
+from sphexa_tpu.sph.particles import ParticleState, SimConstants
+
+from test_gravity import plummer
+
+
+def _plummer_state(n=2000, seed=3):
+    x, y, z, m = plummer(n, seed)
+    lim = float(np.max(np.abs([x, y, z]))) * 1.01
+    box = Box.create(-lim, lim)
+    f32 = lambda a: jnp.asarray(a, jnp.float32)
+    state = ParticleState.zeros(n)
+    import dataclasses
+
+    state = dataclasses.replace(
+        state,
+        x=f32(x), y=f32(y), z=f32(z),
+        h=jnp.full(n, 0.02, jnp.float32), m=f32(m),
+        min_dt=jnp.float32(1e-4), min_dt_m1=jnp.float32(1e-4),
+    )
+    const = SimConstants(g=1.0).normalized()
+    return state, box, const
+
+
+class TestNbodyPropagator:
+    def test_runs_and_reports_egrav(self):
+        state, box, const = _plummer_state()
+        sim = Simulation(state, box, const, prop="nbody")
+        d = sim.step()
+        assert "egrav" in d and d["egrav"] < 0.0
+        assert d["dt"] > 0.0
+        assert sim.iteration == 1
+
+    def test_energy_conservation_few_steps(self):
+        """Total (kinetic + potential) energy drift over 5 steps stays small
+        relative to |egrav| — the Barnes-Hut + integrator sanity bound."""
+        state, box, const = _plummer_state()
+        sim = Simulation(state, box, const, prop="nbody")
+        history = []
+        for _ in range(5):
+            d = sim.step()
+            s = sim.state
+            ekin = float(0.5 * jnp.sum(s.m * (s.vx**2 + s.vy**2 + s.vz**2)))
+            history.append(ekin + d["egrav"])
+        drift = abs(history[-1] - history[0]) / abs(history[0])
+        assert drift < 5e-2, f"energy drift {drift} over 5 steps: {history}"
+
+    def test_step0_accel_matches_direct(self):
+        """One tiny step's velocity change direction must match direct-sum
+        gravity (the nbody propagator is the only acceleration source)."""
+        state, box, const = _plummer_state(n=1500)
+        sim = Simulation(state, box, const, prop="nbody")
+        sim.step()
+        s = sim.state  # arrays now SFC-sorted
+        ax_d, ay_d, az_d, _ = direct_gravity(s.x, s.y, s.z, s.m, s.h)
+        dt = float(s.min_dt)
+        # velocity after the first step ~ a*(dt + dt_m1/2) per the Press
+        # scheme from rest; compare directions via normalized dot product
+        v = np.stack([np.asarray(s.vx), np.asarray(s.vy), np.asarray(s.vz)], 1)
+        a = np.stack([np.asarray(ax_d), np.asarray(ay_d), np.asarray(az_d)], 1)
+        vn = np.linalg.norm(v, axis=1)
+        an = np.linalg.norm(a, axis=1)
+        ok = (vn > 1e-12) & (an > 1e-12)
+        cos = np.sum(v[ok] * a[ok], axis=1) / (vn[ok] * an[ok])
+        assert np.quantile(cos, 0.05) > 0.97, "velocities not aligned with gravity"
+
+
+class TestHydroGravity:
+    def test_std_hydro_with_gravity_smoke(self):
+        """std-SPH with g != 0 runs and reports egrav (Evrard-style coupling,
+        gravity_wrapper.hpp usage inside computeForces)."""
+        side = 10
+        n = side**3
+        rng = np.random.default_rng(0)
+        g = (np.arange(side) + 0.5) / side - 0.5
+        X, Y, Z = np.meshgrid(g, g, g, indexing="ij")
+        x = X.ravel() + rng.normal(0, 1e-3, n)
+        y = Y.ravel() + rng.normal(0, 1e-3, n)
+        z = Z.ravel() + rng.normal(0, 1e-3, n)
+        box = Box.create(-0.5, 0.5)
+        import dataclasses
+
+        state = ParticleState.zeros(n)
+        state = dataclasses.replace(
+            state,
+            x=jnp.asarray(x, jnp.float32),
+            y=jnp.asarray(y, jnp.float32),
+            z=jnp.asarray(z, jnp.float32),
+            h=jnp.full(n, 0.15, jnp.float32),
+            m=jnp.full(n, 1.0 / n, jnp.float32),
+            temp=jnp.full(n, 10.0, jnp.float32),
+            min_dt=jnp.float32(1e-6), min_dt_m1=jnp.float32(1e-6),
+        )
+        const = SimConstants(ng0=50, ngmax=100, g=1.0).normalized()
+        sim = Simulation(state, box, const, prop="std")
+        d = sim.step()
+        assert sim.gravity_on
+        assert "egrav" in d and d["egrav"] < 0.0
+        d2 = sim.step()
+        assert np.isfinite(d2["rho_max"])
