@@ -35,13 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    from sphexa_tpu.init import init_sedov
+    from sphexa_tpu.init import CASES, make_initializer
     from sphexa_tpu.observables import conserved_quantities
     from sphexa_tpu.simulation import _PROPAGATORS, Simulation
 
-    initializers = {"sedov": init_sedov}
-    if args.init not in initializers:
-        print(f"unknown --init {args.init!r}; available: {sorted(initializers)}",
+    if args.init not in CASES:
+        print(f"unknown --init {args.init!r}; available: {sorted(CASES)}",
               file=sys.stderr)
         return 2
     if args.prop not in _PROPAGATORS:
@@ -50,7 +49,7 @@ def main(argv=None) -> int:
         return 2
     if args.avclean and args.prop != "ve":
         print("--avclean only applies to --prop ve; ignoring", file=sys.stderr)
-    state, box, const = initializers[args.init](args.side)
+    state, box, const = make_initializer(args.init)(args.side)
 
     sim = Simulation(state, box, const, prop=args.prop,
                      av_clean=args.avclean and args.prop == "ve")

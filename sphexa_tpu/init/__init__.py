@@ -2,10 +2,57 @@
 
 Counterpart of the reference's ``main/src/init/``: each case is a settings
 dict + coordinate generation + field initialization, producing a
-ParticleState, a Box, and SimConstants.
+ParticleState, a Box, and SimConstants. ``make_initializer`` is the factory
+(init/factory.hpp:43-111) keyed by the same case names the reference CLI
+accepts.
 """
 
-from sphexa_tpu.init.grid import regular_grid
-from sphexa_tpu.init.sedov import init_sedov, sedov_constants
+from typing import Callable, Dict
 
-__all__ = ["regular_grid", "init_sedov", "sedov_constants"]
+from sphexa_tpu.init.evrard import evrard_constants, init_evrard
+from sphexa_tpu.init.gresho_chan import gresho_chan_constants, init_gresho_chan
+from sphexa_tpu.init.grid import regular_grid
+from sphexa_tpu.init.isobaric_cube import (
+    init_isobaric_cube,
+    isobaric_cube_constants,
+)
+from sphexa_tpu.init.kelvin_helmholtz import (
+    init_kelvin_helmholtz,
+    kelvin_helmholtz_constants,
+)
+from sphexa_tpu.init.noh import init_noh, noh_constants
+from sphexa_tpu.init.sedov import init_sedov, sedov_constants
+from sphexa_tpu.init.wind_shock import init_wind_shock, wind_shock_constants
+
+# case name -> init function; the name set matches the reference's --init
+# choices (main/src/init/factory.hpp:59-100)
+CASES: Dict[str, Callable] = {
+    "sedov": init_sedov,
+    "noh": init_noh,
+    "evrard": init_evrard,
+    "gresho-chan": init_gresho_chan,
+    "isobaric-cube": init_isobaric_cube,
+    "kelvin-helmholtz": init_kelvin_helmholtz,
+    "wind-shock": init_wind_shock,
+}
+
+
+def make_initializer(name: str) -> Callable:
+    """Look up a test case by reference CLI name (init/factory.hpp)."""
+    if name not in CASES:
+        raise ValueError(f"unknown test case '{name}'; have {sorted(CASES)}")
+    return CASES[name]
+
+
+__all__ = [
+    "CASES",
+    "make_initializer",
+    "regular_grid",
+    "init_sedov", "sedov_constants",
+    "init_noh", "noh_constants",
+    "init_evrard", "evrard_constants",
+    "init_gresho_chan", "gresho_chan_constants",
+    "init_isobaric_cube", "isobaric_cube_constants",
+    "init_kelvin_helmholtz", "kelvin_helmholtz_constants",
+    "init_wind_shock", "wind_shock_constants",
+]

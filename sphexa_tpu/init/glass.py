@@ -1,0 +1,93 @@
+"""Particle-lattice assembly helpers for initial conditions.
+
+Counterpart of the reference's glass-block machinery (main/src/init/
+utils.hpp readTemplateBlock + grid.hpp assembleCuboid/cutSphere/
+cappedPyramidStretch/computeStretchFactor). The reference tiles a
+pre-relaxed 'glass' template read from an HDF5 file; since the template is
+an external artifact, this module generates an equivalent irregular-but-
+uniform block procedurally: a lattice with deterministic sub-spacing
+jitter, which breaks the grid axes' alignment (the property the glass
+provides) while keeping the distribution statistically uniform and free of
+close pairs.
+"""
+
+from typing import Tuple
+
+import numpy as np
+
+
+def jittered_lattice(
+    lo, hi, counts, seed: int = 42, jitter: float = 0.2
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Jittered lattice with ``counts=(nx,ny,nz)`` points spanning the cuboid
+    [lo, hi) — the generator form of assembleCuboid (grid.hpp:201) for
+    anisotropic boxes (thin slabs, multi-layer setups)."""
+    rng = np.random.default_rng(seed)
+    lo = np.asarray(lo, np.float64)
+    hi = np.asarray(hi, np.float64)
+    steps = (hi - lo) / np.asarray(counts, np.float64)
+    lines = [
+        lo[d] + steps[d] * (0.5 + np.arange(counts[d])) for d in range(3)
+    ]
+    zz, yy, xx = np.meshgrid(lines[2], lines[1], lines[0], indexing="ij")
+    n = int(np.prod(counts))
+    out = []
+    for d, grid in enumerate((xx, yy, zz)):
+        delta = rng.uniform(-jitter, jitter, size=n) * steps[d]
+        out.append(lo[d] + np.mod(grid.ravel() + delta - lo[d], hi[d] - lo[d]))
+    return out[0], out[1], out[2]
+
+
+def cut_sphere(r: float, x, y, z, center=None):
+    """Keep only particles inside radius r (grid.hpp cutSphere)."""
+    if center is None:
+        center = (0.0, 0.0, 0.0)
+    keep = (x - center[0]) ** 2 + (y - center[1]) ** 2 + (z - center[2]) ** 2 <= r * r
+    return x[keep], y[keep], z[keep]
+
+
+def contract_rho_profile(x, y, z):
+    """Multiply coordinates by sqrt(r): uniform sphere -> rho ~ 1/r profile
+    (evrard_init.hpp contractRhoProfile)."""
+    radius = np.sqrt(x * x + y * y + z * z)
+    c = np.sqrt(radius)
+    return x * c, y * c, z * c
+
+
+def compute_stretch_factor(r_int: float, r_ext: float, rho_ratio: float) -> float:
+    """Radius s such that contracting [-s,s]^3 into the inner cube and
+    expanding the rest yields density ratio rho_ratio (grid.hpp:399-409)."""
+    hc = r_int**3
+    rc = r_ext**3
+    s = np.cbrt(rho_ratio * hc * rc / (rc - hc + rho_ratio * hc))
+    assert r_int < s < r_ext
+    return float(s)
+
+
+def capped_pyramid_stretch(x, y, z, r_int: float, s: float, r_ext: float):
+    """Vectorized scale factor moving outer-shell points toward the origin
+    while keeping density constant (grid.hpp:334-378). Applies to points
+    with max|coord| > s; callers mask accordingly."""
+    ax = np.stack([np.abs(x), np.abs(y), np.abs(z)])
+    mx = np.maximum(ax.max(axis=0), 1e-30)
+    radius = np.sqrt((ax**2).sum(axis=0))
+    # ray-cube intersection distances: outer cube, stretch cube, inner cube
+    rp = radius * (r_ext / mx)
+    sp = radius * (s / mx)
+    hp = radius * (r_int / mx)
+    expo = 0.75
+    a = (rp - hp) / np.power(np.maximum(rp - sp, 1e-30), expo)
+    new_radius = a * np.power(np.maximum(radius - sp, 0.0), expo) + hp
+    return new_radius / radius
+
+
+def compress_center_cube(x, y, z, r_int: float, s: float, r_ext: float, eps=0.0):
+    """Create a high-density center cube: contract [-s,s]^3 by r_int/s and
+    pull the surrounding shell inward (isobaric_cube_init.hpp:129-152)."""
+    inner = (
+        (np.abs(x) - s <= eps) & (np.abs(y) - s <= eps) & (np.abs(z) - s <= eps)
+    )
+    scale = np.where(
+        inner, r_int / s, capped_pyramid_stretch(x, y, z, r_int, s, r_ext)
+    )
+    return x * scale, y * scale, z * scale

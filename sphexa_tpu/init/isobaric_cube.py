@@ -1,0 +1,86 @@
+"""Isobaric cube initial conditions.
+
+Physics-equivalent of the reference's ``main/src/init/isobaric_cube_init.hpp``:
+a dense cube (rhoInt = 8) in pressure equilibrium with its surroundings
+(rhoExt = 1, same p). A perfect scheme keeps it static; spurious surface
+tension at the contact discontinuity deforms it.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from sphexa_tpu.init.glass import (
+    compress_center_cube,
+    compute_stretch_factor,
+    jittered_lattice,
+)
+from sphexa_tpu.init.utils import build_state, h_from_density, settings_to_constants
+from sphexa_tpu.sfc.box import BoundaryType, Box
+from sphexa_tpu.sph.particles import ParticleState, SimConstants, ideal_gas_cv
+
+
+def isobaric_cube_constants() -> Dict[str, float]:
+    """Test-case settings (isobaric_cube_init.hpp IsobaricCubeConstants)."""
+    return {
+        "r": 0.25, "rDelta": 0.25, "dim": 3, "gamma": 5.0 / 3.0,
+        "rhoExt": 1.0, "rhoInt": 8.0, "pIsobaric": 2.5,
+        "minDt": 1e-4, "minDt_m1": 1e-4, "epsilon": 1e-15,
+        "pairInstability": 0.0, "mui": 10.0, "gravConstant": 0.0,
+        "ng0": 100, "ngmax": 150,
+    }
+
+
+def init_isobaric_cube(
+    side: int, overrides: Optional[Dict[str, float]] = None
+) -> Tuple[ParticleState, Box, SimConstants]:
+    """Setup per IsobaricCubeGlass::init: uniform fill of the periodic box
+    [-2r, 2r]^3, then compress the center [-s, s]^3 into [-r, r]^3 so the
+    density contrast is rhoInt/rhoExt; equal-mass particles throughout."""
+    settings = isobaric_cube_constants()
+    if overrides:
+        settings.update(overrides)
+
+    r = settings["r"]
+    r_ext = 2 * r
+    rho_int, rho_ext = settings["rhoInt"], settings["rhoExt"]
+
+    x, y, z = jittered_lattice(
+        (-r_ext, -r_ext, -r_ext), (r_ext, r_ext, r_ext), (side, side, side)
+    )
+    n = x.shape[0]
+
+    s = compute_stretch_factor(r, r_ext, rho_int / rho_ext)
+    x, y, z = compress_center_cube(
+        x, y, z, r, s, r_ext, eps=settings["pairInstability"]
+    )
+
+    n_internal = n * (s / r_ext) ** 3
+    m_part = (2 * r) ** 3 * rho_int / n_internal
+
+    const = settings_to_constants(settings)
+    h_int = h_from_density(settings["ng0"], m_part, rho_int)
+    h_ext = h_from_density(settings["ng0"], m_part, rho_ext)
+
+    gamma = settings["gamma"]
+    p_iso = settings["pIsobaric"]
+    u_int = p_iso / (gamma - 1.0) / rho_int
+    u_ext = p_iso / (gamma - 1.0) / rho_ext
+    eps = settings["epsilon"]
+    cv = ideal_gas_cv(settings["mui"], gamma)
+
+    ax, ay, az = np.abs(x), np.abs(y), np.abs(z)
+    outside = (ax > r + eps) | (ay > r + eps) | (az > r + eps)
+    far_out = (ax > r + 2 * h_ext) | (ay > r + 2 * h_ext) | (az > r + 2 * h_ext)
+    dist = np.maximum.reduce([ax - r, ay - r, az - r])
+    # taper h from h_int at the cube surface to h_ext two h_ext away
+    h_near = h_int * (1 - dist / (2 * h_ext)) + h_ext * dist / (2 * h_ext)
+    h = np.where(outside, np.where(far_out, h_ext, h_near), h_int)
+    temp = np.where(outside, u_ext, u_int) / cv
+
+    box = Box.create(-r_ext, r_ext, boundary=BoundaryType.periodic)
+    state = build_state(
+        x, y, z, 0.0, 0.0, 0.0, h, m_part, temp,
+        settings["minDt"], const.alphamin, settings["minDt_m1"],
+    )
+    return state, box, const

@@ -6,13 +6,12 @@ thermal-energy spike at the origin. The semi-analytic solution makes this
 the primary hydrodynamics correctness benchmark (BASELINE.md).
 """
 
-import dataclasses
 from typing import Dict, Optional, Tuple
 
 import numpy as np
-import jax.numpy as jnp
 
 from sphexa_tpu.init.grid import regular_grid
+from sphexa_tpu.init.utils import build_state, settings_to_constants, sphere_h_init
 from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sph.particles import ParticleState, SimConstants, ideal_gas_cv
 
@@ -45,33 +44,18 @@ def init_sedov(
     x, y, z = regular_grid(r, side)
 
     total_volume = (2 * r) ** 3
-    h_init = np.cbrt(3.0 / (4 * np.pi) * settings["ng0"] * total_volume / n) * 0.5
+    h_init = sphere_h_init(settings["ng0"], total_volume, n)
     m_part = settings["mTotal"] / n
 
-    const = SimConstants(
-        ng0=int(settings["ng0"]),
-        ngmax=int(settings["ngmax"]),
-        gamma=settings["gamma"],
-        mui=settings["mui"],
-        g=settings["gravConstant"],
-    ).normalized()
+    const = settings_to_constants(settings)
 
     cv = ideal_gas_cv(settings["mui"], settings["gamma"])
     r2 = x**2 + y**2 + z**2
     u = settings["ener0"] * np.exp(-(r2 / settings["width"] ** 2)) + settings["u0"]
     temp = u / cv
 
-    f32 = lambda a: jnp.asarray(a, jnp.float32)
-    full = lambda v: jnp.full(n, v, jnp.float32)
-    zeros = lambda: jnp.zeros(n, jnp.float32)
-    state = ParticleState(
-        x=f32(x), y=f32(y), z=f32(z),
-        x_m1=zeros(), y_m1=zeros(), z_m1=zeros(),
-        vx=zeros(), vy=zeros(), vz=zeros(),
-        h=full(h_init), m=full(m_part), temp=f32(temp),
-        du=zeros(), du_m1=zeros(), alpha=full(const.alphamin),
-        ttot=jnp.float32(0.0),
-        min_dt=jnp.float32(settings["minDt"]),
-        min_dt_m1=jnp.float32(settings["minDt_m1"]),
+    state = build_state(
+        x, y, z, 0.0, 0.0, 0.0, h_init, m_part, temp,
+        settings["minDt"], const.alphamin, settings["minDt_m1"],
     )
     return state, box, const

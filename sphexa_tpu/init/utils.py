@@ -1,0 +1,77 @@
+"""Shared initial-condition field assembly.
+
+The counterpart of the common tail of every reference init function
+(initXxxFields in main/src/init/*.hpp): fill masses/smoothing lengths,
+derive temperature from internal energy, and seed the integrator history
+(x_m1 = vx * minDt — positions are advanced from stored deltas,
+sph/positions.hpp:66-80).
+"""
+
+from typing import Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from sphexa_tpu.sph.particles import ParticleState, SimConstants
+
+
+def settings_to_constants(settings: Dict[str, float]) -> SimConstants:
+    """Map reference-style settings keys onto SimConstants (the analog of
+    BuiltinWriter funneling the settings map into ParticlesData attributes,
+    main/src/init/settings.hpp:60-80)."""
+    kw = {}
+    key_map = {
+        "ng0": ("ng0", int),
+        "ngmax": ("ngmax", int),
+        "gamma": ("gamma", float),
+        "mui": ("mui", float),
+        "gravConstant": ("g", float),
+        "Kcour": ("k_cour", float),
+        "Krho": ("k_rho", float),
+        "alphamin": ("alphamin", float),
+        "alphamax": ("alphamax", float),
+    }
+    for skey, (field, cast) in key_map.items():
+        if skey in settings:
+            kw[field] = cast(settings[skey])
+    return SimConstants(**kw).normalized()
+
+
+def build_state(
+    x, y, z, vx, vy, vz, h, m, temp, min_dt: float, alpha,
+    min_dt_m1: Optional[float] = None,
+) -> ParticleState:
+    """Assemble a ParticleState from per-particle numpy/jnp fields.
+
+    Scalars for vx/vy/vz/h/m/temp/alpha broadcast to the particle count.
+    """
+    n = np.asarray(x).shape[0]
+    f32 = lambda a: (
+        jnp.full(n, float(a), jnp.float32)
+        if np.ndim(a) == 0
+        else jnp.asarray(a, jnp.float32)
+    )
+    vx, vy, vz = f32(vx), f32(vy), f32(vz)
+    zeros = jnp.zeros(n, jnp.float32)
+    return ParticleState(
+        x=f32(x), y=f32(y), z=f32(z),
+        x_m1=vx * min_dt, y_m1=vy * min_dt, z_m1=vz * min_dt,
+        vx=vx, vy=vy, vz=vz,
+        h=f32(h), m=f32(m), temp=f32(temp),
+        du=zeros, du_m1=zeros, alpha=f32(alpha),
+        ttot=jnp.float32(0.0),
+        min_dt=jnp.float32(min_dt),
+        min_dt_m1=jnp.float32(min_dt_m1 if min_dt_m1 is not None else min_dt),
+    )
+
+
+def sphere_h_init(ng0: float, volume: float, n: int) -> float:
+    """Smoothing length so each particle sees ~ng0 neighbors in a uniform
+    distribution of n particles over ``volume`` (the recurring
+    0.5 * cbrt(3 ng0 V / (4 pi n)) expression of the init files)."""
+    return float(np.cbrt(3.0 / (4 * np.pi) * ng0 * volume / n) * 0.5)
+
+
+def h_from_density(ng0: float, m_part: float, rho: float) -> float:
+    """h for ~ng0 neighbors at mass density rho (0.5 cbrt(3 ng0 m/(4 pi rho)))."""
+    return float(0.5 * np.cbrt(3.0 * ng0 * m_part / (4.0 * np.pi * rho)))

@@ -1,0 +1,158 @@
+"""Init-case tests: field/geometry invariants for every built-in test case
+plus short propagator runs. Mirrors the reference's main/test/init/grid.cpp
+and the per-case settings in main/src/init/*.hpp.
+"""
+
+import numpy as np
+import pytest
+
+from sphexa_tpu.init import (
+    CASES,
+    init_evrard,
+    init_gresho_chan,
+    init_isobaric_cube,
+    init_kelvin_helmholtz,
+    init_noh,
+    init_wind_shock,
+    make_initializer,
+)
+from sphexa_tpu.sfc.box import BoundaryType
+from sphexa_tpu.simulation import Simulation
+
+
+def _np(state, f):
+    return np.asarray(getattr(state, f))
+
+
+class TestFactory:
+    def test_all_cases_registered(self):
+        assert set(CASES) == {
+            "sedov", "noh", "evrard", "gresho-chan", "isobaric-cube",
+            "kelvin-helmholtz", "wind-shock",
+        }
+
+    def test_unknown_case_raises(self):
+        with pytest.raises(ValueError):
+            make_initializer("nope")
+
+
+class TestNoh:
+    def test_geometry_and_velocity(self):
+        state, box, const = init_noh(12)
+        x, y, z = _np(state, "x"), _np(state, "y"), _np(state, "z")
+        r = np.sqrt(x**2 + y**2 + z**2)
+        assert state.n > 0.4 * 12**3  # sphere cut keeps pi/6 of the cube
+        assert np.all(r <= 0.5 + 1e-6)
+        # unit radial inflow
+        vdotr = (_np(state, "vx") * x + _np(state, "vy") * y + _np(state, "vz") * z)
+        speed = np.sqrt(
+            _np(state, "vx") ** 2 + _np(state, "vy") ** 2 + _np(state, "vz") ** 2
+        )
+        assert np.all(vdotr < 0)
+        np.testing.assert_allclose(speed, 1.0, rtol=1e-5)
+        assert box.boundaries[0] == BoundaryType.open
+        # total mass = mTotal
+        np.testing.assert_allclose(_np(state, "m").sum(), 1.0, rtol=1e-5)
+
+
+class TestEvrard:
+    def test_profile_and_h(self):
+        state, box, const = init_evrard(12)
+        x, y, z = _np(state, "x"), _np(state, "y"), _np(state, "z")
+        r = np.sqrt(x**2 + y**2 + z**2)
+        assert np.all(r <= 1.0 + 1e-6)
+        assert const.g == 1.0
+        # rho ~ 1/r: shell mass within r grows ~ r^2 => N(<0.5) ~ 4x N(<0.25)
+        n_inner = (r < 0.25).sum()
+        n_mid = (r < 0.5).sum()
+        assert 2.5 < n_mid / max(n_inner, 1) < 6.0
+        # h grows with radius (h ~ r^(1/3))
+        h = _np(state, "h")
+        assert h[r > 0.8].mean() > h[r < 0.2].mean()
+
+
+class TestGreshoChan:
+    def test_velocity_profile(self):
+        state, box, const = init_gresho_chan(12)
+        x, y = _np(state, "x"), _np(state, "y")
+        psi = np.sqrt(x**2 + y**2) / 0.2
+        v = np.sqrt(_np(state, "vx") ** 2 + _np(state, "vy") ** 2)
+        np.testing.assert_allclose(v[psi <= 1.0], psi[psi <= 1.0], rtol=1e-4)
+        assert np.all(v[psi > 2.0] < 1e-6)
+        assert np.all(_np(state, "vz") == 0)
+        # azimuthal: v . r == 0
+        vdotr = _np(state, "vx") * x + _np(state, "vy") * y
+        np.testing.assert_allclose(vdotr, 0.0, atol=1e-5)
+
+    def test_short_run_stays_finite(self):
+        state, box, const = init_gresho_chan(10)
+        sim = Simulation(state, box, const, prop="std", block=256)
+        for _ in range(3):
+            sim.step()
+        for f in ("x", "vx", "temp", "h"):
+            assert np.all(np.isfinite(_np(sim.state, f))), f
+
+
+class TestIsobaricCube:
+    def test_density_contrast(self):
+        state, box, const = init_isobaric_cube(14)
+        x, y, z = _np(state, "x"), _np(state, "y"), _np(state, "z")
+        r = 0.25
+        inner = (np.abs(x) < r) & (np.abs(y) < r) & (np.abs(z) < r)
+        v_in = (2 * r) ** 3
+        v_out = 1.0 - v_in
+        ratio = (inner.sum() / v_in) / ((~inner).sum() / v_out)
+        assert 5.0 < ratio < 11.0, ratio  # target 8
+        # isobaric: temp_in/temp_ext = rhoExt/rhoInt
+        t = _np(state, "temp")
+        np.testing.assert_allclose(
+            t[inner].mean() / t[~inner].mean(), 1.0 / 8.0, rtol=0.05
+        )
+
+
+class TestKelvinHelmholtz:
+    def test_band_contrast_and_shear(self):
+        state, box, const = init_kelvin_helmholtz(12)
+        y = _np(state, "y")
+        inner = (y > 0.25) & (y < 0.75)
+        ratio = (inner.sum() / 0.5) / ((~inner).sum() / 0.5)
+        assert 1.6 < ratio < 2.4, ratio  # target 2
+        vx = _np(state, "vx")
+        assert vx[(y > 0.35) & (y < 0.65)].mean() < -0.3  # band flows -x
+        assert vx[(y < 0.15) | (y > 0.85)].mean() > 0.3  # outside flows +x
+        # seeded vy perturbation has the right amplitude
+        assert 0.001 < np.abs(_np(state, "vy")).max() <= 0.011
+
+
+class TestWindShock:
+    def test_blob_and_wind(self):
+        state, box, const = init_wind_shock(10)
+        x, y, z = _np(state, "x"), _np(state, "y"), _np(state, "z")
+        r, rs = 0.125, 0.025
+        rpos = np.sqrt((x - r) ** 2 + (y - r) ** 2 + (z - r) ** 2)
+        cloud = rpos <= rs
+        assert cloud.sum() > 5
+        vx = _np(state, "vx")
+        assert np.all(vx[cloud] == 0)
+        np.testing.assert_allclose(vx[~cloud], 2.7, rtol=1e-5)
+        # number-density contrast ~ 10
+        v_cloud = 4 / 3 * np.pi * rs**3
+        v_tot = (8 * r) * (2 * r) * (2 * r)
+        ratio = (cloud.sum() / v_cloud) / ((~cloud).sum() / (v_tot - v_cloud))
+        assert 5.0 < ratio < 15.0, ratio
+
+
+class TestEvrardRun:
+    def test_gravity_hydro_run(self):
+        state, box, const = init_evrard(10)
+        sim = Simulation(state, box, const, prop="std", block=256, theta=0.5)
+        for _ in range(3):
+            sim.step()
+        st = sim.state
+        for f in ("x", "vx", "temp", "h"):
+            assert np.all(np.isfinite(_np(st, f))), f
+        # cold sphere must start collapsing: net radial velocity < 0
+        x, y, z = _np(st, "x"), _np(st, "y"), _np(st, "z")
+        rr = np.maximum(np.sqrt(x**2 + y**2 + z**2), 1e-9)
+        vr = (_np(st, "vx") * x + _np(st, "vy") * y + _np(st, "vz") * z) / rr
+        assert vr.mean() < 0
