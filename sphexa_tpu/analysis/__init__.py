@@ -1,0 +1,14 @@
+"""Semi-analytic solutions and L1-error comparison utilities.
+
+Counterpart of the reference's ``main/src/analytical_solutions/``: the
+Sedov-Taylor self-similar solution (sedov_solution/*.cpp), the Noh
+implosion solution and the L1 comparisons (compare_solutions.py,
+compare_noh.py) used as the de-facto physics correctness baseline
+(SURVEY.md §6).
+"""
+
+from sphexa_tpu.analysis.noh import noh_solution
+from sphexa_tpu.analysis.sedov import sedov_solution
+from sphexa_tpu.analysis.compare import compute_output_fields, l1_error
+
+__all__ = ["noh_solution", "sedov_solution", "compute_output_fields", "l1_error"]

@@ -1,0 +1,78 @@
+"""L1-error comparison of a particle state against an analytic solution.
+
+Counterpart of the reference's compare_solutions.py / compare_noh.py L1
+metric (sum |sol - sim| / N, computed at every particle's radius) and of
+the saveFields recompute pass (ve_hydro.hpp:225-286) that derives
+rho/p/u/vel from the conserved fields before output.
+"""
+
+import functools
+from typing import Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.neighbors.cell_list import find_neighbors
+from sphexa_tpu.propagator import PropagatorConfig
+from sphexa_tpu.sfc.box import Box
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+from sphexa_tpu.sph import hydro_std, hydro_ve
+from sphexa_tpu.sph.particles import ParticleState
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "pipeline"))
+def _output_fields(
+    state: ParticleState, box: Box, cfg: PropagatorConfig, pipeline: str
+):
+    # Neighbor search needs key order; results are scattered back to the
+    # caller's particle order so they stay aligned with the conserved
+    # fields of `state` (which a snapshot writes as-is).
+    keys = compute_sfc_keys(state.x, state.y, state.z, box, curve=cfg.curve)
+    order = jnp.argsort(keys)
+    skeys = keys[order]
+    g = lambda a: a[order]
+    x, y, z, h, m = (g(state.x), g(state.y), g(state.z), g(state.h), g(state.m))
+    temp = g(state.temp)
+
+    nidx, nmask, _, _ = find_neighbors(x, y, z, h, skeys, box, cfg.nbr)
+    if pipeline == "ve":
+        # VE-consistent density/EOS (the saveFields recompute pass,
+        # ve_hydro.hpp:225-286): rho = kx m / xm with gradh normalization
+        xm = hydro_ve.compute_xmass(
+            x, y, z, h, m, nidx, nmask, box, cfg.const, cfg.block
+        )
+        kx, gradh = hydro_ve.compute_ve_def_gradh(
+            x, y, z, h, m, xm, nidx, nmask, box, cfg.const, cfg.block
+        )
+        _, c, rho, p = hydro_ve.compute_eos_ve(temp, m, kx, xm, gradh, cfg.const)
+    else:
+        rho = hydro_std.compute_density(
+            x, y, z, h, m, nidx, nmask, box, cfg.const, cfg.block
+        )
+        p, c = hydro_std.compute_eos_std(temp, rho, cfg.const)
+
+    unsort = lambda a: jnp.zeros_like(a).at[order].set(a)
+    rho, p, c = unsort(rho), unsort(p), unsort(c)
+    u = cfg.const.cv * state.temp
+    vel = jnp.sqrt(state.vx**2 + state.vy**2 + state.vz**2)
+    r = jnp.sqrt(state.x**2 + state.y**2 + state.z**2)
+    return {"r": r, "rho": rho, "p": p, "u": u, "vel": vel, "c": c}
+
+
+def compute_output_fields(
+    state: ParticleState, box: Box, cfg: PropagatorConfig, pipeline: str = "std"
+) -> Dict[str, np.ndarray]:
+    """Recompute the dependent output fields (rho, p, u, |v|, c) plus radii
+    from a conserved-field state, as numpy arrays in the state's particle
+    order. ``pipeline`` selects the density/EOS estimator consistent with
+    the propagator that evolved the state ('std' or 've')."""
+    out = _output_fields(state, box, cfg, "ve" if pipeline == "ve" else "std")
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def l1_error(sim: np.ndarray, sol: np.ndarray) -> float:
+    """Reference L1 metric: mean absolute deviation (compare_noh.py:146)."""
+    sim = np.asarray(sim, np.float64)
+    sol = np.asarray(sol, np.float64)
+    return float(np.abs(sol - sim).sum() / sim.shape[0])

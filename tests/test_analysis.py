@@ -1,0 +1,120 @@
+"""Tests for the semi-analytic Sedov/Noh solutions and L1 comparison.
+
+Mirrors the role of the reference's ReFrame e2e checks
+(.jenkins/reframe_ci.py:349-371): the analytic solvers are validated
+against known exact values, then short simulation runs are compared via L1.
+"""
+
+import numpy as np
+import pytest
+
+from sphexa_tpu.analysis import (
+    compute_output_fields,
+    l1_error,
+    noh_solution,
+    sedov_solution,
+)
+from sphexa_tpu.analysis.sedov import _energy_alpha, _exponents
+
+
+def _alpha(gamma, xgeom=3.0, omega=0.0):
+    expo, coef, xg2 = _exponents(xgeom, omega, gamma)
+    return _energy_alpha(expo, coef, xgeom, omega, gamma, xg2)
+
+
+class TestSedovSolution:
+    def test_alpha_gamma_14(self):
+        # known value for the spherical gamma=1.4 standard case (Kamm 2000)
+        assert abs(_alpha(1.4) - 0.8510719) < 1e-4
+
+    def test_post_shock_density_ratio(self):
+        gamma = 5.0 / 3.0
+        sol = sedov_solution(np.array([1e-6]), time=0.05, gamma=gamma)
+        r2 = sol["r_shock"]
+        just_in = sedov_solution(np.array([r2 * 0.9999]), time=0.05, gamma=gamma)
+        ratio = just_in["rho"][0]  # rho0 = 1
+        assert abs(ratio - (gamma + 1) / (gamma - 1)) < 0.05  # -> 4
+
+    def test_energy_self_consistency(self):
+        # integrate the profile's total energy: must return eblast
+        gamma, t, eblast = 5.0 / 3.0, 0.05, 1.0
+        sol0 = sedov_solution(np.array([1.0]), time=t, gamma=gamma, eblast=eblast)
+        r2 = sol0["r_shock"]
+        r = np.linspace(1e-6, r2 * (1 - 1e-9), 20000)
+        s = sedov_solution(r, time=t, gamma=gamma, eblast=eblast)
+        e_density = 0.5 * s["rho"] * s["vel"] ** 2 + s["p"] / (gamma - 1.0)
+        e_tot = np.trapezoid(e_density * 4 * np.pi * r**2, r)
+        assert abs(e_tot - eblast) < 0.02 * eblast
+
+    def test_density_vanishes_at_origin(self):
+        sol = sedov_solution(np.array([1e-8, 1e-3]), time=0.05)
+        assert sol["rho"][0] < 1e-3
+
+    def test_upstream_state(self):
+        sol = sedov_solution(np.array([10.0]), time=0.05, rho0=2.0, p0=0.5)
+        assert sol["rho"][0] == 2.0
+        assert sol["p"][0] == 0.5
+        assert sol["vel"][0] == 0.0
+
+    def test_shock_radius_scaling(self):
+        # r2 ~ t^(2/5)
+        r2a = sedov_solution(np.array([1.0]), time=0.01)["r_shock"]
+        r2b = sedov_solution(np.array([1.0]), time=0.32)["r_shock"]
+        assert abs(r2b / r2a - 32 ** (2.0 / 5.0)) < 1e-6
+
+
+class TestNohSolution:
+    def test_post_shock_density(self):
+        gamma = 5.0 / 3.0
+        sol = noh_solution(np.array([1e-4]), time=0.1, gamma=gamma)
+        assert abs(sol["rho"][0] - ((gamma + 1) / (gamma - 1)) ** 3) < 1e-9  # 64
+
+    def test_shock_front(self):
+        sol = noh_solution(np.array([1.0]), time=0.3)
+        assert abs(sol["r_shock"] - 0.5 * (2.0 / 3.0) * 0.3) < 1e-12
+
+    def test_upstream_pileup(self):
+        # free-falling upstream gas: rho = rho0 (1 + t/r)^2
+        t, r = 0.1, 0.4
+        sol = noh_solution(np.array([r]), time=t)
+        assert abs(sol["rho"][0] - (1 + t / r) ** 2) < 1e-12
+        assert sol["vel"][0] == 1.0
+
+    def test_post_shock_at_rest(self):
+        sol = noh_solution(np.array([1e-4]), time=0.3)
+        assert sol["vel"][0] == 0.0
+        assert sol["u"][0] == 0.5
+
+
+class TestL1:
+    def test_l1_zero_for_exact(self):
+        a = np.linspace(0, 1, 100)
+        assert l1_error(a, a) == 0.0
+
+    def test_l1_scale(self):
+        assert abs(l1_error(np.zeros(10), np.full(10, 2.0)) - 2.0) < 1e-12
+
+
+@pytest.mark.parametrize("case", ["sedov"])
+def test_sedov_e2e_l1(case):
+    """Short Sedov run tracked against the analytic solution — the same
+    comparison the reference CI asserts at -n 50 -s 200 (L1_rho = 0.138);
+    at this tiny scale (16^3, ~60 steps) we assert loose sanity bounds."""
+    from sphexa_tpu.init import init_sedov
+    from sphexa_tpu.simulation import Simulation
+
+    state, box, const = init_sedov(16)
+    sim = Simulation(state, box, const, prop="std", block=512)
+    for _ in range(120):
+        sim.step()
+    t = float(sim.state.ttot)
+
+    fields = compute_output_fields(sim.state, sim.box, sim._cfg)
+    sol = sedov_solution(fields["r"], time=t, eblast=1.0, gamma=const.gamma)
+    l1_rho = l1_error(fields["rho"], sol["rho"])
+    # shock has formed and the sim tracks the solution to first order
+    # (measured 0.32 at this 16^3 resolution; reference CI gets 0.138 at 50^3)
+    assert np.isfinite(l1_rho)
+    assert l1_rho < 0.6, l1_rho
+    # a density peak forms (smoothed well below the analytic 4x jump at 16^3)
+    assert 1.3 < fields["rho"].max() < 8.0
