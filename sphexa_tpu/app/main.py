@@ -8,6 +8,7 @@ reported, not silently ignored.
 """
 
 import argparse
+import os
 import sys
 import time
 
@@ -35,13 +36,14 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    from sphexa_tpu.init import CASES, make_initializer
+    from sphexa_tpu.init import make_initializer
     from sphexa_tpu.observables import conserved_quantities
     from sphexa_tpu.simulation import _PROPAGATORS, Simulation
 
-    if args.init not in CASES:
-        print(f"unknown --init {args.init!r}; available: {sorted(CASES)}",
-              file=sys.stderr)
+    try:
+        initializer = make_initializer(args.init)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
         return 2
     if args.prop not in _PROPAGATORS:
         print(f"unknown --prop {args.prop!r}; available: {sorted(_PROPAGATORS)}",
@@ -49,34 +51,91 @@ def main(argv=None) -> int:
         return 2
     if args.avclean and args.prop != "ve":
         print("--avclean only applies to --prop ve; ignoring", file=sys.stderr)
-    state, box, const = make_initializer(args.init)(args.side)
+    state, box, const = initializer(args.side)
 
     sim = Simulation(state, box, const, prop=args.prop,
                      av_clean=args.avclean and args.prop == "ve")
     log = (lambda *a, **k: None) if args.quiet else print
     log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
 
+    # resuming from a snapshot continues the iteration numbering, and an
+    # integer -s is the END iteration (sphexa.cpp main-loop semantics)
+    from sphexa_tpu.init.file_init import looks_like_file, parse_file_spec
+
+    if looks_like_file(args.init):
+        from sphexa_tpu.io.snapshot import read_step_attrs
+
+        restart_attrs = read_step_attrs(*parse_file_spec(args.init))
+        sim.iteration = int(restart_attrs.get("iteration", 0))
+        log(f"# restart from iteration {sim.iteration}, t={float(state.ttot):.6g}")
+
     num_steps = int(args.stop) if float(args.stop).is_integer() else None
     target_time = None if num_steps is not None else float(args.stop)
 
+    # -w: integer = dump every N iterations, float = every t interval
+    # (arg_parser.hpp:99-118 int-vs-float dispatch, same as -s)
+    dump_path = None
+    w = args.write_every
+    w_steps = int(w) if w > 0 and float(w).is_integer() else None
+    w_time = w if w > 0 and w_steps is None else None
+    next_dump_time = [float(state.ttot) + w_time] if w_time else None
+    if w > 0:
+        case_tag = "".join(c if c.isalnum() else "_" for c in args.init)
+        dump_path = f"{args.out_dir}/dump_{case_tag}.h5"
+        if os.path.exists(dump_path):
+            print(f"# removing stale {dump_path} (would interleave old steps)",
+                  file=sys.stderr)
+            os.remove(dump_path)
+
+    want_fields = [f for f in args.out_fields.split(",") if f]
+
+    def maybe_dump(it):
+        """Restartable snapshot on the -w schedule; derived fields are
+        recomputed like the reference's saveFields pass, consistently with
+        the active propagator."""
+        due = (w_steps is not None and it % w_steps == 0) or (
+            next_dump_time is not None and float(sim.state.ttot) >= next_dump_time[0]
+        )
+        if dump_path is None or not due:
+            return
+        if next_dump_time is not None:
+            next_dump_time[0] += w_time
+        from sphexa_tpu.analysis import compute_output_fields
+        from sphexa_tpu.io import write_snapshot
+
+        extra = compute_output_fields(sim.state, sim.box, sim._cfg,
+                                      pipeline=args.prop)
+        if want_fields:
+            unknown = [f for f in want_fields if f not in extra]
+            if unknown:
+                print(f"# -f fields not available, skipped: {unknown}",
+                      file=sys.stderr)
+            extra = {k: v for k, v in extra.items() if k in want_fields}
+        step = write_snapshot(
+            dump_path, sim.state, sim.box, const, iteration=it, extra_fields=extra
+        )
+        log(f"# wrote Step#{step} -> {dump_path}")
+
     t0 = time.time()
-    it = 0
+    it0 = sim.iteration
     while True:
         d = sim.step()
-        it += 1
+        it = sim.iteration
         e = conserved_quantities(sim.state, const)
         log(
             f"it {it:5d}  t={float(sim.state.ttot):.6g} dt={d['dt']:.4g} "
             f"etot={float(e['etot']):.6f} ecin={float(e['ecin']):.4g} "
             f"eint={float(e['eint']):.4g} nc~{d['nc_mean']:.0f}"
         )
+        maybe_dump(it)
         if num_steps is not None and it >= num_steps:
             break
         if target_time is not None and float(sim.state.ttot) >= target_time:
             break
     dt_wall = time.time() - t0
-    log(f"# {it} iterations in {dt_wall:.2f}s "
-        f"({state.n * it / dt_wall / 1e6:.3f}M particle-updates/s)")
+    n_done = sim.iteration - it0
+    log(f"# {n_done} iterations in {dt_wall:.2f}s "
+        f"({state.n * n_done / dt_wall / 1e6:.3f}M particle-updates/s)")
     return 0
 
 

@@ -7,6 +7,7 @@ ParticleState, a Box, and SimConstants. ``make_initializer`` is the factory
 accepts.
 """
 
+import functools
 from typing import Callable, Dict
 
 from sphexa_tpu.init.evrard import evrard_constants, init_evrard
@@ -38,10 +39,18 @@ CASES: Dict[str, Callable] = {
 
 
 def make_initializer(name: str) -> Callable:
-    """Look up a test case by reference CLI name (init/factory.hpp)."""
-    if name not in CASES:
-        raise ValueError(f"unknown test case '{name}'; have {sorted(CASES)}")
-    return CASES[name]
+    """Look up a test case by reference CLI name, or build a file-restart
+    initializer for 'path[:step]' arguments (init/factory.hpp:43-111)."""
+    if name in CASES:
+        return CASES[name]
+    from sphexa_tpu.init.file_init import init_from_file, looks_like_file
+
+    if looks_like_file(name):
+        return functools.partial(init_from_file, name)
+    raise ValueError(
+        f"unknown test case '{name}' (not a case name in {sorted(CASES)}, "
+        "not an existing snapshot file)"
+    )
 
 
 __all__ = [

@@ -1,0 +1,21 @@
+"""Snapshot/checkpoint file I/O.
+
+Counterpart of the reference's ``main/src/io/`` (IFileWriter/IFileReader,
+ifile_io_hdf5.cpp, h5part_wrapper.hpp): snapshots are HDF5 files with one
+``Step#n`` group per dump, per-particle datasets inside the group, and the
+restart metadata (iteration, time, minDt, physics constants, box) stored as
+group attributes — the same layout the reference writes, so dumps are
+restartable by construction (sphexa.cpp:227-231).
+
+A dependency-free ``.npz`` container is supported as a fallback format
+(single snapshot per file) selected by file extension.
+"""
+
+from sphexa_tpu.io.snapshot import (
+    list_steps,
+    read_snapshot,
+    write_ascii,
+    write_snapshot,
+)
+
+__all__ = ["write_snapshot", "read_snapshot", "list_steps", "write_ascii"]

@@ -1,0 +1,208 @@
+"""Snapshot writer/reader: HDF5 (H5Part-style Step#n groups) + npz fallback.
+
+Layout mirrors the reference (main/src/io/ifile_io_hdf5.cpp:49-314):
+
+    dump.h5
+    └── Step#0
+        ├── attrs: iteration, time, minDt, minDt_m1, gravConstant, gamma,
+        │          ng0, ngmax, Kcour, mui, box_lo, box_hi, box_boundaries, ...
+        ├── x, y, z, x_m1, ..., alpha   (one dataset per conserved field)
+        └── rho, p, ...                 (optional derived output fields)
+
+Restart = read the conserved fields + attributes back into a ParticleState
+and SimConstants (the FileInit path, main/src/init/file_init.hpp).
+"""
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from sphexa_tpu.sfc.box import BoundaryType, Box
+from sphexa_tpu.sph.particles import ParticleState, SimConstants
+
+try:
+    import h5py
+
+    _HAVE_H5PY = True
+except ImportError:  # pragma: no cover - h5py is present in the image
+    _HAVE_H5PY = False
+
+# conserved per-particle fields: the restartable set (ipropagator
+# conservedFields + particles_data.hpp checkpoint list)
+CONSERVED_FIELDS = (
+    "x", "y", "z", "x_m1", "y_m1", "z_m1", "vx", "vy", "vz",
+    "h", "m", "temp", "du", "du_m1", "alpha",
+)
+
+# SimConstants fields serialized as attributes, reference attribute names
+# (particles_data.hpp:170-191)
+_CONST_ATTRS = {
+    "ng0": "ng0", "ngmax": "ngmax", "k_cour": "Kcour", "k_rho": "Krho",
+    "gamma": "gamma", "mui": "muiConst", "alphamin": "alphamin",
+    "alphamax": "alphamax", "decay_constant": "decay_constant",
+    "at_min": "Atmin", "at_max": "Atmax", "g": "gravConstant",
+    "eps": "eps", "eta_acc": "etaAcc", "max_dt_increase": "maxDtIncrease",
+    "sinc_index": "sincIndex",
+}
+
+
+def _is_h5(path: str) -> bool:
+    return os.path.splitext(path)[1].lower() in (".h5", ".hdf5", ".h5part")
+
+
+def _step_attrs(state: ParticleState, box: Box, const: SimConstants,
+                iteration: int) -> Dict[str, np.ndarray]:
+    attrs = {
+        "iteration": np.int64(iteration),
+        "numParticlesGlobal": np.int64(state.n),
+        "time": np.float64(state.ttot),
+        "minDt": np.float64(state.min_dt),
+        "minDt_m1": np.float64(state.min_dt_m1),
+        "box_lo": np.asarray(box.lo, np.float64),
+        "box_hi": np.asarray(box.hi, np.float64),
+        "box_boundaries": np.asarray([int(b) for b in box.boundaries], np.int64),
+    }
+    for field, name in _CONST_ATTRS.items():
+        attrs[name] = np.float64(getattr(const, field))
+    return attrs
+
+
+def write_snapshot(
+    path: str,
+    state: ParticleState,
+    box: Box,
+    const: SimConstants,
+    iteration: int = 0,
+    extra_fields: Optional[Dict[str, np.ndarray]] = None,
+) -> int:
+    """Append one restartable snapshot; returns the step index written.
+
+    ``extra_fields`` adds derived output datasets (rho, p, ...) alongside
+    the conserved set — the analog of the -f/--wextra field selection.
+    """
+    fields = {f: np.asarray(getattr(state, f)) for f in CONSERVED_FIELDS}
+    if extra_fields:
+        fields.update({k: np.asarray(v) for k, v in extra_fields.items()})
+    attrs = _step_attrs(state, box, const, iteration)
+
+    if _is_h5(path):
+        if not _HAVE_H5PY:
+            raise RuntimeError("h5py unavailable; use a .npz path instead")
+        with h5py.File(path, "a") as f:
+            step = len([k for k in f.keys() if k.startswith("Step#")])
+            g = f.create_group(f"Step#{step}")
+            for k, v in attrs.items():
+                g.attrs[k] = v
+            for k, v in fields.items():
+                g.create_dataset(k, data=v)
+            return step
+
+    arrays = {f"field_{k}": v for k, v in fields.items()}
+    arrays.update({f"attr_{k}": v for k, v in attrs.items()})
+    np.savez_compressed(path, **arrays)
+    return 0
+
+
+def list_steps(path: str) -> List[int]:
+    """Step indices present in a snapshot file."""
+    if _is_h5(path):
+        with h5py.File(path, "r") as f:
+            return sorted(
+                int(k.split("#")[1]) for k in f.keys() if k.startswith("Step#")
+            )
+    return [0]
+
+
+def _read_raw(path: str, step: int):
+    if _is_h5(path):
+        with h5py.File(path, "r") as f:
+            steps = sorted(
+                int(k.split("#")[1]) for k in f.keys() if k.startswith("Step#")
+            )
+            if not steps:
+                raise ValueError(f"{path} contains no Step#n groups")
+            if step < 0:
+                if -step > len(steps):
+                    raise ValueError(
+                        f"step {step} out of range for {path}; have {steps}"
+                    )
+                idx = steps[step]
+            elif step in steps:
+                idx = step
+            else:
+                raise ValueError(f"step {step} not in {path}; have {steps}")
+            g = f[f"Step#{idx}"]
+            fields = {k: np.asarray(g[k]) for k in g.keys()}
+            attrs = {k: np.asarray(v) for k, v in g.attrs.items()}
+            return fields, attrs
+    data = np.load(path)
+    fields = {k[6:]: data[k] for k in data.files if k.startswith("field_")}
+    attrs = {k[5:]: data[k] for k in data.files if k.startswith("attr_")}
+    return fields, attrs
+
+
+def read_step_attrs(path: str, step: int = -1) -> Dict[str, np.ndarray]:
+    """Step attributes only (iteration, time, constants) — cheap restart
+    metadata probe without loading the particle datasets."""
+    if _is_h5(path):
+        with h5py.File(path, "r") as f:
+            steps = sorted(
+                int(k.split("#")[1]) for k in f.keys() if k.startswith("Step#")
+            )
+            if not steps:
+                raise ValueError(f"{path} contains no Step#n groups")
+            idx = steps[step] if step < 0 else step
+            return {k: np.asarray(v) for k, v in f[f"Step#{idx}"].attrs.items()}
+    _, attrs = _read_raw(path, step)
+    return attrs
+
+
+def read_snapshot(
+    path: str, step: int = -1
+) -> Tuple[ParticleState, Box, SimConstants, Dict[str, np.ndarray]]:
+    """Restore (state, box, const, extra_fields) from a snapshot.
+
+    ``step``: index into the file's Step#n groups; negative counts from the
+    end (the reference's ``--init dump.h5:-1`` semantics, file_init.hpp).
+    """
+    fields, attrs = _read_raw(path, step)
+
+    missing = [f for f in CONSERVED_FIELDS if f not in fields]
+    if missing:
+        raise ValueError(f"{path} is not restartable: missing fields {missing}")
+
+    const_kw = {}
+    for field, name in _CONST_ATTRS.items():
+        if name in attrs:
+            cast = int if field in ("ng0", "ngmax") else float
+            const_kw[field] = cast(attrs[name])
+    const = SimConstants(**const_kw).normalized()
+
+    box = Box(
+        lo=jnp.asarray(attrs["box_lo"], jnp.float32),
+        hi=jnp.asarray(attrs["box_hi"], jnp.float32),
+        boundaries=tuple(BoundaryType(int(b)) for b in attrs["box_boundaries"]),
+    )
+
+    f32 = lambda k: jnp.asarray(fields[k], jnp.float32)
+    state = ParticleState(
+        **{f: f32(f) for f in CONSERVED_FIELDS},
+        ttot=jnp.float32(attrs["time"]),
+        min_dt=jnp.float32(attrs["minDt"]),
+        min_dt_m1=jnp.float32(attrs["minDt_m1"]),
+    )
+    extra = {k: v for k, v in fields.items() if k not in CONSERVED_FIELDS}
+    return state, box, const, extra
+
+
+def write_ascii(
+    path: str, columns: Dict[str, np.ndarray], delimiter: str = " "
+) -> None:
+    """Plain-text column dump (the --ascii output path,
+    main/src/io/ifile_io_ascii.cpp): one header line, one row per particle."""
+    names = list(columns)
+    data = np.column_stack([np.asarray(columns[k]) for k in names])
+    np.savetxt(path, data, delimiter=delimiter, header=delimiter.join(names))
