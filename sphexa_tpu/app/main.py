@@ -12,6 +12,8 @@ import os
 import sys
 import time
 
+import numpy as np
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -37,7 +39,11 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     from sphexa_tpu.init import make_initializer
-    from sphexa_tpu.observables import conserved_quantities
+    from sphexa_tpu.observables import (
+        ConstantsWriter,
+        conserved_quantities,
+        make_observable,
+    )
     from sphexa_tpu.simulation import _PROPAGATORS, Simulation
 
     try:
@@ -59,18 +65,31 @@ def main(argv=None) -> int:
     log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
 
     # resuming from a snapshot continues the iteration numbering, and an
-    # integer -s is the END iteration (sphexa.cpp main-loop semantics)
+    # integer -s is the END iteration (sphexa.cpp main-loop semantics);
+    # built-in case names take precedence over same-named files, exactly
+    # like make_initializer
+    from sphexa_tpu.init import CASES
     from sphexa_tpu.init.file_init import looks_like_file, parse_file_spec
 
-    if looks_like_file(args.init):
+    case_name = args.init
+    is_restart = args.init not in CASES and looks_like_file(args.init)
+    if is_restart:
         from sphexa_tpu.io.snapshot import read_step_attrs
 
         restart_attrs = read_step_attrs(*parse_file_spec(args.init))
         sim.iteration = int(restart_attrs.get("iteration", 0))
-        log(f"# restart from iteration {sim.iteration}, t={float(state.ttot):.6g}")
+        case_name = (
+            np.asarray(restart_attrs["initCase"]).item().decode()
+            if "initCase" in restart_attrs
+            else ""
+        )
+        log(f"# restart from iteration {sim.iteration}, t={float(state.ttot):.6g}"
+            + (f" (case {case_name})" if case_name else ""))
 
     num_steps = int(args.stop) if float(args.stop).is_integer() else None
     target_time = None if num_steps is not None else float(args.stop)
+
+    os.makedirs(args.out_dir, exist_ok=True)
 
     # -w: integer = dump every N iterations, float = every t interval
     # (arg_parser.hpp:99-118 int-vs-float dispatch, same as -s)
@@ -89,10 +108,26 @@ def main(argv=None) -> int:
 
     want_fields = [f for f in args.out_fields.split(",") if f]
 
-    def maybe_dump(it):
+    # per-iteration constants.txt row; observable selected by the test case
+    # (observables/factory.hpp:46-70) — on restart, by the case name the
+    # snapshot recorded
+    observable = make_observable(case_name)
+    constants_path = f"{args.out_dir}/constants.txt"
+    if not is_restart and os.path.exists(constants_path):
+        print(f"# truncating stale {constants_path}", file=sys.stderr)
+        os.remove(constants_path)
+    constants = ConstantsWriter(constants_path, observable)
+
+    def output_fields():
+        from sphexa_tpu.analysis import compute_output_fields
+
+        return compute_output_fields(sim.state, sim.box, sim._cfg,
+                                     pipeline=args.prop)
+
+    def maybe_dump(it, fields=None):
         """Restartable snapshot on the -w schedule; derived fields are
         recomputed like the reference's saveFields pass, consistently with
-        the active propagator."""
+        the active propagator (or reused from the observable pass)."""
         due = (w_steps is not None and it % w_steps == 0) or (
             next_dump_time is not None and float(sim.state.ttot) >= next_dump_time[0]
         )
@@ -100,11 +135,9 @@ def main(argv=None) -> int:
             return
         if next_dump_time is not None:
             next_dump_time[0] += w_time
-        from sphexa_tpu.analysis import compute_output_fields
         from sphexa_tpu.io import write_snapshot
 
-        extra = compute_output_fields(sim.state, sim.box, sim._cfg,
-                                      pipeline=args.prop)
+        extra = fields if fields is not None else output_fields()
         if want_fields:
             unknown = [f for f in want_fields if f not in extra]
             if unknown:
@@ -112,7 +145,8 @@ def main(argv=None) -> int:
                       file=sys.stderr)
             extra = {k: v for k, v in extra.items() if k in want_fields}
         step = write_snapshot(
-            dump_path, sim.state, sim.box, const, iteration=it, extra_fields=extra
+            dump_path, sim.state, sim.box, const, iteration=it,
+            extra_fields=extra, case=case_name,
         )
         log(f"# wrote Step#{step} -> {dump_path}")
 
@@ -121,13 +155,19 @@ def main(argv=None) -> int:
     while True:
         d = sim.step()
         it = sim.iteration
-        e = conserved_quantities(sim.state, const)
+        e = conserved_quantities(sim.state, const, egrav=d.get("egrav", 0.0))
+        fields = output_fields() if observable.needs_fields else None
+        row = constants.write(it, sim.state, sim.box, e, fields)
+        maybe_dump(it, fields)
+        extra_cols = " ".join(
+            f"{n}={v:.4g}" for n, v in zip(observable.extra_columns, row[7:])
+        )
         log(
             f"it {it:5d}  t={float(sim.state.ttot):.6g} dt={d['dt']:.4g} "
             f"etot={float(e['etot']):.6f} ecin={float(e['ecin']):.4g} "
             f"eint={float(e['eint']):.4g} nc~{d['nc_mean']:.0f}"
+            + (f" {extra_cols}" if extra_cols else "")
         )
-        maybe_dump(it)
         if num_steps is not None and it >= num_steps:
             break
         if target_time is not None and float(sim.state.ttot) >= target_time:

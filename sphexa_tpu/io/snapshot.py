@@ -77,16 +77,22 @@ def write_snapshot(
     const: SimConstants,
     iteration: int = 0,
     extra_fields: Optional[Dict[str, np.ndarray]] = None,
+    case: str = "",
 ) -> int:
     """Append one restartable snapshot; returns the step index written.
 
     ``extra_fields`` adds derived output datasets (rho, p, ...) alongside
     the conserved set — the analog of the -f/--wextra field selection.
+    ``case`` records the originating test-case name so a restarted run can
+    re-select the matching observable (the reference records its init
+    settings as file attributes for the same reason, settings.hpp:45-57).
     """
     fields = {f: np.asarray(getattr(state, f)) for f in CONSERVED_FIELDS}
     if extra_fields:
         fields.update({k: np.asarray(v) for k, v in extra_fields.items()})
     attrs = _step_attrs(state, box, const, iteration)
+    if case:
+        attrs["initCase"] = np.bytes_(case)
 
     if _is_h5(path):
         if not _HAVE_H5PY:
@@ -116,28 +122,33 @@ def list_steps(path: str) -> List[int]:
     return [0]
 
 
+def _resolve_step(steps: List[int], step: int, path: str) -> int:
+    """Validate a step selector against the file's Step#n indices;
+    negative counts from the end."""
+    if not steps:
+        raise ValueError(f"{path} contains no Step#n groups")
+    if step < 0:
+        if -step > len(steps):
+            raise ValueError(f"step {step} out of range for {path}; have {steps}")
+        return steps[step]
+    if step not in steps:
+        raise ValueError(f"step {step} not in {path}; have {steps}")
+    return step
+
+
+def _h5_steps(f) -> List[int]:
+    return sorted(int(k.split("#")[1]) for k in f.keys() if k.startswith("Step#"))
+
+
 def _read_raw(path: str, step: int):
     if _is_h5(path):
         with h5py.File(path, "r") as f:
-            steps = sorted(
-                int(k.split("#")[1]) for k in f.keys() if k.startswith("Step#")
-            )
-            if not steps:
-                raise ValueError(f"{path} contains no Step#n groups")
-            if step < 0:
-                if -step > len(steps):
-                    raise ValueError(
-                        f"step {step} out of range for {path}; have {steps}"
-                    )
-                idx = steps[step]
-            elif step in steps:
-                idx = step
-            else:
-                raise ValueError(f"step {step} not in {path}; have {steps}")
+            idx = _resolve_step(_h5_steps(f), step, path)
             g = f[f"Step#{idx}"]
             fields = {k: np.asarray(g[k]) for k in g.keys()}
             attrs = {k: np.asarray(v) for k, v in g.attrs.items()}
             return fields, attrs
+    _resolve_step([0], step, path)  # npz files hold exactly one snapshot
     data = np.load(path)
     fields = {k[6:]: data[k] for k in data.files if k.startswith("field_")}
     attrs = {k[5:]: data[k] for k in data.files if k.startswith("attr_")}
@@ -149,12 +160,7 @@ def read_step_attrs(path: str, step: int = -1) -> Dict[str, np.ndarray]:
     metadata probe without loading the particle datasets."""
     if _is_h5(path):
         with h5py.File(path, "r") as f:
-            steps = sorted(
-                int(k.split("#")[1]) for k in f.keys() if k.startswith("Step#")
-            )
-            if not steps:
-                raise ValueError(f"{path} contains no Step#n groups")
-            idx = steps[step] if step < 0 else step
+            idx = _resolve_step(_h5_steps(f), step, path)
             return {k: np.asarray(v) for k, v in f[f"Step#{idx}"].attrs.items()}
     _, attrs = _read_raw(path, step)
     return attrs

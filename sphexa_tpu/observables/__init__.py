@@ -1,3 +1,31 @@
-from sphexa_tpu.observables.conserved import conserved_quantities
+"""Per-step analysis reductions appended to constants.txt.
 
-__all__ = ["conserved_quantities"]
+Counterpart of the reference's ``main/src/observables/``: conserved
+quantities every step, plus case-specific observables (KH growth rate,
+Mach RMS, wind-bubble survival, gravitational waves) selected by the
+factory.
+"""
+
+from sphexa_tpu.observables.conserved import conserved_quantities
+from sphexa_tpu.observables.extras import (
+    gravitational_wave_signal,
+    kh_growth_rate,
+    mach_rms,
+    wind_bubble_fraction,
+)
+from sphexa_tpu.observables.factory import (
+    BASE_COLUMNS,
+    ConstantsWriter,
+    make_observable,
+)
+
+__all__ = [
+    "conserved_quantities",
+    "kh_growth_rate",
+    "mach_rms",
+    "wind_bubble_fraction",
+    "gravitational_wave_signal",
+    "make_observable",
+    "ConstantsWriter",
+    "BASE_COLUMNS",
+]

@@ -1,0 +1,104 @@
+"""Case-specific observables.
+
+Physics-equivalents of the reference's per-case observables
+(main/src/observables/): Kelvin-Helmholtz growth rate
+(time_energy_growth.hpp:45-110), turbulence Mach RMS
+(turbulence_mach_rms.hpp:39-85), wind-bubble survivor fraction
+(wind_bubble_fraction.hpp:43-97) and the gravitational-wave quadrupole
+signal (grav_waves_calculations.hpp:30-121). All are jnp reductions: under
+a sharded step they lower to psum-style collectives.
+"""
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+# gravitational-wave unit at 10 kpc: G / c^4 / (10 kpc in cm), cgs
+# (grav_waves_calculations.hpp:56-58)
+_G_CGS = 6.6726e-8
+_C_CGS = 2.997924562e10
+GW_UNITS = _G_CGS / _C_CGS**4 / 3.08568025e22
+
+
+def kh_growth_rate(x, y, vy, vol, box) -> jnp.ndarray:
+    """Kelvin-Helmholtz instability amplitude growth (McNally et al. 2012
+    mode projection; time_energy_growth.hpp:45-70): project vy onto the
+    seeded sin(4 pi x) mode, weighted toward the two interfaces."""
+    ybox = box.lengths[1]
+    aux = jnp.where(
+        y < ybox * 0.5,
+        jnp.exp(-4.0 * jnp.pi * jnp.abs(y - 0.25)),
+        jnp.exp(-4.0 * jnp.pi * jnp.abs(ybox - y - 0.25)),
+    )
+    w = vy * vol * aux
+    si = jnp.sum(w * jnp.sin(4.0 * jnp.pi * x))
+    ci = jnp.sum(w * jnp.cos(4.0 * jnp.pi * x))
+    di = jnp.sum(vol * aux)
+    return 2.0 * jnp.sqrt(si**2 + ci**2) / di
+
+
+def mach_rms(vx, vy, vz, c) -> jnp.ndarray:
+    """Root-mean-square Mach number (turbulence_mach_rms.hpp:39-85)."""
+    m2 = (vx**2 + vy**2 + vz**2) / (c * c)
+    return jnp.sqrt(jnp.mean(m2))
+
+
+def wind_bubble_fraction(
+    rho, temp, m, rho_bubble: float, temp_wind: float, initial_mass: float
+) -> jnp.ndarray:
+    """Fraction of the initial cloud mass still in the cloud phase: denser
+    than 0.64 rho_bubble and cooler than 0.9 T_wind
+    (wind_bubble_fraction.hpp:43-57,96)."""
+    survive = (rho >= 0.64 * rho_bubble) & (temp <= 0.9 * temp_wind)
+    return jnp.sum(jnp.where(survive, m, 0.0)) / initial_mass
+
+
+def _d2_quadrupole(i, j, pos, vel, acc, m) -> jnp.ndarray:
+    """Second time derivative of the traceless quadrupole moment component
+    (i, j), from positions/velocities/accelerations
+    (grav_waves_calculations.hpp:88-121)."""
+    if i == j:
+        v2 = vel[0] ** 2 + vel[1] ** 2 + vel[2] ** 2
+        rdota = pos[0] * acc[0] + pos[1] * acc[1] + pos[2] * acc[2]
+        out = jnp.sum(
+            (3.0 * (vel[i] ** 2 + pos[i] * acc[i]) - v2 - rdota) * m
+        )
+        return out * 2.0 / 3.0
+    return jnp.sum(
+        (2.0 * vel[i] * vel[j] + acc[i] * pos[j] + pos[i] * acc[j]) * m
+    )
+
+
+def gravitational_wave_signal(
+    x, y, z, vx, vy, vz, ax, ay, az, m, theta: float, phi: float
+) -> Tuple[jnp.ndarray, jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """(h+_tt, hx_tt, d2Q components) for an observer at (theta, phi),
+    10 kpc, cgs units (gravitational_waves.hpp + computeHtt)."""
+    pos, vel, acc = (x, y, z), (vx, vy, vz), (ax, ay, az)
+    q = {
+        "xx": _d2_quadrupole(0, 0, pos, vel, acc, m),
+        "yy": _d2_quadrupole(1, 1, pos, vel, acc, m),
+        "zz": _d2_quadrupole(2, 2, pos, vel, acc, m),
+        "xy": _d2_quadrupole(0, 1, pos, vel, acc, m),
+        "xz": _d2_quadrupole(0, 2, pos, vel, acc, m),
+        "yz": _d2_quadrupole(1, 2, pos, vel, acc, m),
+    }
+    sin2t, sin2p = jnp.sin(2 * theta), jnp.sin(2 * phi)
+    cos2p = jnp.cos(2 * phi)
+    sint, cost = jnp.sin(theta), jnp.cos(theta)
+    sinp, cosp = jnp.sin(phi), jnp.cos(phi)
+
+    ibar_tt = (
+        (q["xx"] * cosp**2 + q["yy"] * sinp**2 + q["xy"] * sin2p) * cost**2
+        + q["zz"] * sint**2
+        - (q["xz"] * cosp + q["yz"] * sinp) * sin2t
+    )
+    ibar_pp = q["xx"] * sinp**2 + q["yy"] * cosp**2 - q["xy"] * sin2p
+    ibar_tp = (
+        0.5 * (q["yy"] - q["xx"]) * cost * sin2p
+        + q["xy"] * cost * cos2p
+        + (q["xz"] * sinp - q["yz"] * cosp) * sint
+    )
+    htt_plus = (ibar_tt - ibar_pp) * GW_UNITS
+    htt_cross = 2.0 * ibar_tp * GW_UNITS
+    return htt_plus, htt_cross, q

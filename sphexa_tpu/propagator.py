@@ -53,6 +53,9 @@ class PropagatorConfig:
     av_clean: bool = False
     gravity: Optional[GravityConfig] = None
     grav_meta: Optional[GravityTreeMeta] = None
+    # include the per-particle accelerations in the step diagnostics (the
+    # gravitational-wave observable consumes them, gravitational_waves.hpp)
+    keep_accels: bool = False
 
 
 def _sort_by_keys(state: ParticleState, box: Box, curve: str):
@@ -91,7 +94,7 @@ def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
 def _integrate_and_finish(
     state: ParticleState, box: Box, const: SimConstants,
     ax, ay, az, du, dt, nc, occ, rho, extra=None, extra_diag=None,
-    update_smoothing=True,
+    update_smoothing=True, keep_accels=False,
 ):
     """Shared step tail: drift/kick + PBC wrap, smoothing-length nudge,
     state rebuild, diagnostics. Every propagator's force stage funnels
@@ -117,6 +120,8 @@ def _integrate_and_finish(
         "occupancy": occ,
         "rho_max": jnp.max(rho),
     }
+    if keep_accels:
+        diagnostics.update({"ax": ax, "ay": ay, "az": az})
     diagnostics.update(extra_diag or {})
     return new_state, box, diagnostics
 
@@ -160,7 +165,8 @@ def step_hydro_std(
 
     dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=const)
     return _integrate_and_finish(
-        state, box, const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag
+        state, box, const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
+        keep_accels=cfg.keep_accels,
     )
 
 
@@ -230,7 +236,7 @@ def step_hydro_ve(
     dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts, const=const)
     return _integrate_and_finish(
         state, box, const, ax, ay, az, du, dt, nc, occ, rho,
-        extra={"alpha": alpha}, extra_diag=gdiag,
+        extra={"alpha": alpha}, extra_diag=gdiag, keep_accels=cfg.keep_accels,
     )
 
 
@@ -258,4 +264,5 @@ def step_nbody(
     return _integrate_and_finish(
         state, box, const, ax, ay, az, zero, dt, nc, jnp.int32(0), zero,
         extra_diag={**gdiag, "egrav": egrav}, update_smoothing=False,
+        keep_accels=cfg.keep_accels,
     )

@@ -46,6 +46,7 @@ def make_propagator_config(
     curve: str = "hilbert",
     min_cap: int = 0,
     av_clean: bool = False,
+    keep_accels: bool = False,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -58,7 +59,8 @@ def make_propagator_config(
         level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block, curve=curve
     )
     return PropagatorConfig(
-        const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean
+        const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean,
+        keep_accels=keep_accels,
     )
 
 
@@ -79,6 +81,7 @@ class Simulation:
         av_clean: bool = False,
         theta: float = 0.5,
         grav_bucket: int = 64,
+        keep_accels: bool = False,
     ):
         self.state = state
         self.box = box
@@ -87,6 +90,7 @@ class Simulation:
         self.block = block
         self.curve = curve
         self.av_clean = av_clean
+        self.keep_accels = keep_accels
         self.ngmax = ngmax or const.ngmax
         self.theta = theta
         self.grav_bucket = grav_bucket
@@ -113,7 +117,7 @@ class Simulation:
         self._cfg = make_propagator_config(
             self.state, self.box, self.const,
             ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
-            av_clean=self.av_clean,
+            av_clean=self.av_clean, keep_accels=self.keep_accels,
         )
         if self.gravity_on:
             self._configure_gravity(grav_margin)
@@ -192,7 +196,10 @@ class Simulation:
         if not self._config_still_valid(diagnostics):
             self._configure()
             reconfigured = True
-        out = {k: float(v) for k, v in diagnostics.items()}
+        out = {
+            k: np.asarray(v) if getattr(v, "ndim", 0) else float(v)
+            for k, v in diagnostics.items()
+        }
         out["reconfigured"] = float(reconfigured)
         return out
 

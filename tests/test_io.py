@@ -64,10 +64,25 @@ def test_read_step_attrs(tmp_path, small_case):
 
     state, box, const = small_case
     path = str(tmp_path / "dump.h5")
-    write_snapshot(path, state, box, const, iteration=42)
+    write_snapshot(path, state, box, const, iteration=42, case="sedov")
     attrs = read_step_attrs(path)
     assert int(attrs["iteration"]) == 42
     assert float(attrs["gamma"]) == pytest.approx(const.gamma)
+    assert np.asarray(attrs["initCase"]).item().decode() == "sedov"
+    with pytest.raises(ValueError):
+        read_step_attrs(path, step=5)
+    with pytest.raises(ValueError):
+        read_step_attrs(path, step=-3)
+
+
+def test_npz_step_selection_validated(tmp_path, small_case):
+    state, box, const = small_case
+    path = str(tmp_path / "dump.npz")
+    write_snapshot(path, state, box, const)
+    read_snapshot(path, step=0)
+    read_snapshot(path, step=-1)
+    with pytest.raises(ValueError):
+        read_snapshot(path, step=3)
 
 
 def test_output_fields_follow_particle_order(small_case):
