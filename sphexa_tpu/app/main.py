@@ -29,7 +29,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="integer: dump every N iterations; float: every t interval")
     p.add_argument("-f", default="", dest="out_fields", help="fields to dump")
     p.add_argument("-o", "--outDir", default=".", dest="out_dir")
-    p.add_argument("--prop", default="std", help="propagator: std | ve")
+    p.add_argument("--prop", default="std",
+                   help="propagator: std | ve | turb-ve | nbody")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--avclean", action="store_true")
     return p
@@ -46,43 +47,64 @@ def main(argv=None) -> int:
     )
     from sphexa_tpu.simulation import _PROPAGATORS, Simulation
 
-    try:
-        initializer = make_initializer(args.init)
-    except ValueError as e:
-        print(str(e), file=sys.stderr)
-        return 2
     if args.prop not in _PROPAGATORS:
         print(f"unknown --prop {args.prop!r}; available: {sorted(_PROPAGATORS)}",
               file=sys.stderr)
         return 2
-    if args.avclean and args.prop != "ve":
-        print("--avclean only applies to --prop ve; ignoring", file=sys.stderr)
-    state, box, const = initializer(args.side)
+    if args.avclean and args.prop not in ("ve", "turb-ve"):
+        print("--avclean only applies to --prop ve | turb-ve; ignoring",
+              file=sys.stderr)
 
-    sim = Simulation(state, box, const, prop=args.prop,
-                     av_clean=args.avclean and args.prop == "ve")
-    log = (lambda *a, **k: None) if args.quiet else print
-    log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
-
-    # resuming from a snapshot continues the iteration numbering, and an
-    # integer -s is the END iteration (sphexa.cpp main-loop semantics);
     # built-in case names take precedence over same-named files, exactly
-    # like make_initializer
+    # like make_initializer; a restart reads the snapshot ONCE, recovering
+    # state, metadata and any checkpointed turbulence stirring state
     from sphexa_tpu.init import CASES
     from sphexa_tpu.init.file_init import looks_like_file, parse_file_spec
 
+    log = (lambda *a, **k: None) if args.quiet else print
     case_name = args.init
     is_restart = args.init not in CASES and looks_like_file(args.init)
+    turb_state, turb_cfg, restart_iteration = None, None, 0
     if is_restart:
-        from sphexa_tpu.io.snapshot import read_step_attrs
+        from sphexa_tpu.io.snapshot import read_snapshot_full
 
-        restart_attrs = read_step_attrs(*parse_file_spec(args.init))
-        sim.iteration = int(restart_attrs.get("iteration", 0))
+        state, box, const, extra, attrs = read_snapshot_full(
+            *parse_file_spec(args.init)
+        )
+        restart_iteration = int(attrs.get("iteration", 0))
         case_name = (
-            np.asarray(restart_attrs["initCase"]).item().decode()
-            if "initCase" in restart_attrs
+            np.asarray(attrs["initCase"]).item().decode()
+            if "initCase" in attrs
             else ""
         )
+        if args.prop == "turb-ve" and "turb_phases" in extra:
+            # resume the OU stirring state + config (the reference
+            # checkpoints phases + RNG the same way, turb_ve.hpp:88-97)
+            from sphexa_tpu.sph.hydro_turb import turbulence_state_from_fields
+
+            turb_state, turb_cfg = turbulence_state_from_fields(extra)
+    else:
+        try:
+            initializer = make_initializer(args.init)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        state, box, const = initializer(args.side)
+
+    # observable selected by the test case (observables/factory.hpp:46-70) —
+    # on restart, by the case name the snapshot recorded; field-consuming
+    # observables read rho/c straight from the step diagnostics
+    observable = make_observable(case_name)
+    sim = Simulation(state, box, const, prop=args.prop,
+                     av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
+                     turb_state=turb_state, turb_cfg=turb_cfg,
+                     keep_fields=observable.needs_fields)
+    log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
+
+    # resuming from a snapshot continues the iteration numbering, and an
+    # integer -s is the END iteration (sphexa.cpp main-loop semantics)
+    if is_restart:
+        sim.iteration = restart_iteration
         log(f"# restart from iteration {sim.iteration}, t={float(state.ttot):.6g}"
             + (f" (case {case_name})" if case_name else ""))
 
@@ -108,10 +130,6 @@ def main(argv=None) -> int:
 
     want_fields = [f for f in args.out_fields.split(",") if f]
 
-    # per-iteration constants.txt row; observable selected by the test case
-    # (observables/factory.hpp:46-70) — on restart, by the case name the
-    # snapshot recorded
-    observable = make_observable(case_name)
     constants_path = f"{args.out_dir}/constants.txt"
     if not is_restart and os.path.exists(constants_path):
         print(f"# truncating stale {constants_path}", file=sys.stderr)
@@ -121,8 +139,9 @@ def main(argv=None) -> int:
     def output_fields():
         from sphexa_tpu.analysis import compute_output_fields
 
+        pipeline = "ve" if args.prop in ("ve", "turb-ve") else "std"
         return compute_output_fields(sim.state, sim.box, sim._cfg,
-                                     pipeline=args.prop)
+                                     pipeline=pipeline)
 
     def maybe_dump(it, fields=None):
         """Restartable snapshot on the -w schedule; derived fields are
@@ -144,6 +163,13 @@ def main(argv=None) -> int:
                 print(f"# -f fields not available, skipped: {unknown}",
                       file=sys.stderr)
             extra = {k: v for k, v in extra.items() if k in want_fields}
+        if sim.turb_state is not None:
+            from sphexa_tpu.sph.hydro_turb import turbulence_state_to_fields
+
+            extra = {
+                **extra,
+                **turbulence_state_to_fields(sim.turb_state, sim.turb_cfg),
+            }
         step = write_snapshot(
             dump_path, sim.state, sim.box, const, iteration=it,
             extra_fields=extra, case=case_name,
@@ -156,9 +182,9 @@ def main(argv=None) -> int:
         d = sim.step()
         it = sim.iteration
         e = conserved_quantities(sim.state, const, egrav=d.get("egrav", 0.0))
-        fields = output_fields() if observable.needs_fields else None
+        fields = {"rho": d["rho"], "c": d["c"]} if observable.needs_fields else None
         row = constants.write(it, sim.state, sim.box, e, fields)
-        maybe_dump(it, fields)
+        maybe_dump(it)  # dumps recompute the full derived set (r, p, u, ...)
         extra_cols = " ".join(
             f"{n}={v:.4g}" for n, v in zip(observable.extra_columns, row[7:])
         )

@@ -23,6 +23,7 @@ from sphexa_tpu.init.kelvin_helmholtz import (
 )
 from sphexa_tpu.init.noh import init_noh, noh_constants
 from sphexa_tpu.init.sedov import init_sedov, sedov_constants
+from sphexa_tpu.init.turbulence import init_turbulence, turbulence_constants
 from sphexa_tpu.init.wind_shock import init_wind_shock, wind_shock_constants
 
 # case name -> init function; the name set matches the reference's --init
@@ -35,6 +36,7 @@ CASES: Dict[str, Callable] = {
     "isobaric-cube": init_isobaric_cube,
     "kelvin-helmholtz": init_kelvin_helmholtz,
     "wind-shock": init_wind_shock,
+    "turbulence": init_turbulence,
 }
 
 
@@ -64,4 +66,5 @@ __all__ = [
     "init_isobaric_cube", "isobaric_cube_constants",
     "init_kelvin_helmholtz", "kelvin_helmholtz_constants",
     "init_wind_shock", "wind_shock_constants",
+    "init_turbulence", "turbulence_constants",
 ]

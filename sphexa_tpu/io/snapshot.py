@@ -174,6 +174,16 @@ def read_snapshot(
     ``step``: index into the file's Step#n groups; negative counts from the
     end (the reference's ``--init dump.h5:-1`` semantics, file_init.hpp).
     """
+    state, box, const, extra, _ = read_snapshot_full(path, step)
+    return state, box, const, extra
+
+
+def read_snapshot_full(
+    path: str, step: int = -1
+) -> Tuple[ParticleState, Box, SimConstants, Dict[str, np.ndarray],
+           Dict[str, np.ndarray]]:
+    """read_snapshot + the raw step attributes (iteration, initCase, ...) —
+    single-read restore for callers that need the restart metadata too."""
     fields, attrs = _read_raw(path, step)
 
     missing = [f for f in CONSERVED_FIELDS if f not in fields]
@@ -201,7 +211,7 @@ def read_snapshot(
         min_dt_m1=jnp.float32(attrs["minDt_m1"]),
     )
     extra = {k: v for k, v in fields.items() if k not in CONSERVED_FIELDS}
-    return state, box, const, extra
+    return state, box, const, extra, attrs
 
 
 def write_ascii(

@@ -7,6 +7,7 @@ iteration, time, minDt, etot, ecin, eint, egrav; case-specific observables
 append their own columns.
 """
 
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -102,7 +103,9 @@ class ConstantsWriter:
     def __init__(self, path: str, observable=None):
         self.path = path
         self.observable = observable or TimeAndEnergy()
-        self._wrote_header = False
+        # appending to an existing file (restart) must not inject a second
+        # header line mid-file
+        self._wrote_header = os.path.exists(path) and os.path.getsize(path) > 0
 
     def write(
         self,

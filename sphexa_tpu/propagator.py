@@ -56,6 +56,10 @@ class PropagatorConfig:
     # include the per-particle accelerations in the step diagnostics (the
     # gravitational-wave observable consumes them, gravitational_waves.hpp)
     keep_accels: bool = False
+    # include per-particle rho and sound speed c in the diagnostics (the
+    # field-consuming observables read them, avoiding a second full
+    # density/EOS pass per step); arrays are in the post-step state order
+    keep_fields: bool = False
 
 
 def _sort_by_keys(state: ParticleState, box: Box, curve: str):
@@ -94,7 +98,7 @@ def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
 def _integrate_and_finish(
     state: ParticleState, box: Box, const: SimConstants,
     ax, ay, az, du, dt, nc, occ, rho, extra=None, extra_diag=None,
-    update_smoothing=True, keep_accels=False,
+    update_smoothing=True, keep_accels=False, keep_fields=False, c=None,
 ):
     """Shared step tail: drift/kick + PBC wrap, smoothing-length nudge,
     state rebuild, diagnostics. Every propagator's force stage funnels
@@ -122,6 +126,9 @@ def _integrate_and_finish(
     }
     if keep_accels:
         diagnostics.update({"ax": ax, "ay": ay, "az": az})
+    if keep_fields:
+        diagnostics["rho"] = rho
+        diagnostics["c"] = c if c is not None else jnp.zeros_like(rho)
     diagnostics.update(extra_diag or {})
     return new_state, box, diagnostics
 
@@ -166,22 +173,19 @@ def step_hydro_std(
     dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=const)
     return _integrate_and_finish(
         state, box, const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
-        keep_accels=cfg.keep_accels,
+        keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields, c=c,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def step_hydro_ve(
+def _ve_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree] = None,
-) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
-    """One generalized-volume-element SPH time step.
-
-    Mirrors HydroVeProp::computeForces (ve_hydro.hpp:131-208): sort ->
-    neighbors -> xmass -> ve_def_gradh -> EOS -> IAD -> divv/curlv ->
-    AV switches -> momentum/energy [avClean] -> timestep -> positions ->
-    smoothing-length update. The reference's halo exchanges between stages
-    vanish: XLA materializes whatever communication the shardings imply.
+    gtree: Optional[GravityTree],
+):
+    """The VE force stage shared by the plain and turbulence-stirred
+    propagators (HydroVeProp::computeForces, ve_hydro.hpp:131-208):
+    box regrow -> sort -> neighbors -> xmass -> ve_def_gradh -> EOS ->
+    IAD -> divv/curlv -> AV switches -> momentum/energy [-> gravity].
+    Returns the sorted state plus everything the step tail needs.
     """
     const = cfg.const
     box = make_global_box(state.x, state.y, state.z, box)
@@ -234,10 +238,53 @@ def step_hydro_ve(
         extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
 
     dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts, const=const)
-    return _integrate_and_finish(
-        state, box, const, ax, ay, az, du, dt, nc, occ, rho,
-        extra={"alpha": alpha}, extra_diag=gdiag, keep_accels=cfg.keep_accels,
+    return state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def step_hydro_ve(
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree] = None,
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
+    """One generalized-volume-element SPH time step.
+
+    Mirrors HydroVeProp::step (ve_hydro.hpp:210-223): the VE force stage,
+    then timestep -> positions -> smoothing-length update. The reference's
+    halo exchanges between stages vanish: XLA materializes whatever
+    communication the shardings imply.
+    """
+    (state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag) = _ve_forces(
+        state, box, cfg, gtree
     )
+    return _integrate_and_finish(
+        state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho,
+        extra={"alpha": alpha}, extra_diag=gdiag, keep_accels=cfg.keep_accels,
+        keep_fields=cfg.keep_fields, c=c,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "turb_cfg"))
+def step_turb_ve(
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree], turb, turb_cfg,
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array], object]:
+    """One stirred VE step (TurbVeProp::step, turb_ve.hpp:70-86): VE forces
+    -> timestep -> OU-driven stirring accelerations -> positions ->
+    smoothing-length update. Returns the advanced TurbulenceState too."""
+    from sphexa_tpu.sph.hydro_turb import drive_turbulence
+
+    (state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag) = _ve_forces(
+        state, box, cfg, gtree
+    )
+    ax, ay, az, turb = drive_turbulence(
+        state.x, state.y, state.z, ax, ay, az, dt, turb, turb_cfg
+    )
+    new_state, box, diag = _integrate_and_finish(
+        state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho,
+        extra={"alpha": alpha}, extra_diag=gdiag, keep_accels=cfg.keep_accels,
+        keep_fields=cfg.keep_fields, c=c,
+    )
+    return new_state, box, diag, turb
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))

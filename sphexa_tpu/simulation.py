@@ -25,6 +25,7 @@ from sphexa_tpu.propagator import (
     step_hydro_std,
     step_hydro_ve,
     step_nbody,
+    step_turb_ve,
 )
 from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sfc.keys import compute_sfc_keys
@@ -34,6 +35,7 @@ _PROPAGATORS: Dict[str, Callable] = {
     "std": step_hydro_std,
     "ve": step_hydro_ve,
     "nbody": step_nbody,
+    "turb-ve": step_turb_ve,
 }
 
 
@@ -47,6 +49,7 @@ def make_propagator_config(
     min_cap: int = 0,
     av_clean: bool = False,
     keep_accels: bool = False,
+    keep_fields: bool = False,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -60,7 +63,7 @@ def make_propagator_config(
     )
     return PropagatorConfig(
         const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean,
-        keep_accels=keep_accels,
+        keep_accels=keep_accels, keep_fields=keep_fields,
     )
 
 
@@ -82,6 +85,10 @@ class Simulation:
         theta: float = 0.5,
         grav_bucket: int = 64,
         keep_accels: bool = False,
+        keep_fields: bool = False,
+        turb_cfg=None,
+        turb_state=None,
+        turb_settings: Optional[Dict] = None,
     ):
         self.state = state
         self.box = box
@@ -91,6 +98,7 @@ class Simulation:
         self.curve = curve
         self.av_clean = av_clean
         self.keep_accels = keep_accels
+        self.keep_fields = keep_fields
         self.ngmax = ngmax or const.ngmax
         self.theta = theta
         self.grav_bucket = grav_bucket
@@ -107,6 +115,29 @@ class Simulation:
                 "(traversal_ewald_cpu.hpp analog), which is not wired in yet; "
                 "use open boundaries"
             )
+        # turbulence stirring state (turb-ve propagator): built from the
+        # case settings unless an explicit (cfg, state) pair is given,
+        # e.g. restored from a checkpoint
+        self.turb_cfg = turb_cfg
+        self.turb_state = turb_state
+        if prop == "turb-ve" and self.turb_cfg is None:
+            from sphexa_tpu.init.turbulence import turbulence_constants
+            from sphexa_tpu.sph.hydro_turb import create_stirring_modes
+
+            s = dict(turbulence_constants(), **(turb_settings or {}))
+            self.turb_cfg, fresh_state = create_stirring_modes(
+                lbox=float(np.max(np.asarray(box.lengths))),
+                st_max_modes=int(s["stMaxModes"]),
+                energy_prefac=s["stEnergyPrefac"],
+                mach_velocity=s["stMachVelocity"],
+                sol_weight=s["solWeight"],
+                spect_form=int(s["stSpectForm"]),
+                seed=int(s["rngSeed"]),
+            )
+            # a caller-provided state (checkpoint restore) overrides the
+            # fresh OU phases but keeps the derived static config
+            if self.turb_state is None:
+                self.turb_state = fresh_state
         self.iteration = 0
         self._cfg: Optional[PropagatorConfig] = None
         self._gtree = None
@@ -118,6 +149,7 @@ class Simulation:
             self.state, self.box, self.const,
             ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
             av_clean=self.av_clean, keep_accels=self.keep_accels,
+            keep_fields=self.keep_fields,
         )
         if self.gravity_on:
             self._configure_gravity(grav_margin)
@@ -176,9 +208,16 @@ class Simulation:
         reconfigured = False
         grav_margin = 1.5
         for _attempt in range(3):
-            new_state, new_box, diagnostics = step_fn(
-                self.state, self.box, self._cfg, self._gtree
-            )
+            new_turb = None
+            if self.prop_name == "turb-ve":
+                new_state, new_box, diagnostics, new_turb = step_fn(
+                    self.state, self.box, self._cfg, self._gtree,
+                    self.turb_state, self.turb_cfg,
+                )
+            else:
+                new_state, new_box, diagnostics = step_fn(
+                    self.state, self.box, self._cfg, self._gtree
+                )
             nbr_over = int(diagnostics["occupancy"]) > self._cfg.nbr.cap
             grav_over = self._gravity_overflowed(diagnostics)
             if not nbr_over and not grav_over:
@@ -192,6 +231,8 @@ class Simulation:
             raise RuntimeError("neighbor/gravity caps failed to converge in 3 attempts")
         self.state = new_state
         self.box = new_box
+        if new_turb is not None:
+            self.turb_state = new_turb
         self.iteration += 1
         if not self._config_still_valid(diagnostics):
             self._configure()
