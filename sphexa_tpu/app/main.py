@@ -30,7 +30,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-f", default="", dest="out_fields", help="fields to dump")
     p.add_argument("-o", "--outDir", default=".", dest="out_dir")
     p.add_argument("--prop", default="std",
-                   help="propagator: std | ve | turb-ve | nbody")
+                   help="propagator: std | ve | turb-ve | std-cooling | nbody")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--avclean", action="store_true")
     return p
@@ -143,10 +143,10 @@ def main(argv=None) -> int:
         return compute_output_fields(sim.state, sim.box, sim._cfg,
                                      pipeline=pipeline)
 
-    def maybe_dump(it, fields=None):
+    def maybe_dump(it):
         """Restartable snapshot on the -w schedule; derived fields are
         recomputed like the reference's saveFields pass, consistently with
-        the active propagator (or reused from the observable pass)."""
+        the active propagator."""
         due = (w_steps is not None and it % w_steps == 0) or (
             next_dump_time is not None and float(sim.state.ttot) >= next_dump_time[0]
         )
@@ -156,7 +156,7 @@ def main(argv=None) -> int:
             next_dump_time[0] += w_time
         from sphexa_tpu.io import write_snapshot
 
-        extra = fields if fields is not None else output_fields()
+        extra = output_fields()
         if want_fields:
             unknown = [f for f in want_fields if f not in extra]
             if unknown:

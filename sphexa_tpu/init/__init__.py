@@ -10,7 +10,11 @@ accepts.
 import functools
 from typing import Callable, Dict
 
-from sphexa_tpu.init.evrard import evrard_constants, init_evrard
+from sphexa_tpu.init.evrard import (
+    evrard_constants,
+    init_evrard,
+    init_evrard_cooling,
+)
 from sphexa_tpu.init.gresho_chan import gresho_chan_constants, init_gresho_chan
 from sphexa_tpu.init.grid import regular_grid
 from sphexa_tpu.init.isobaric_cube import (
@@ -37,6 +41,7 @@ CASES: Dict[str, Callable] = {
     "kelvin-helmholtz": init_kelvin_helmholtz,
     "wind-shock": init_wind_shock,
     "turbulence": init_turbulence,
+    "evrard-cooling": init_evrard_cooling,
 }
 
 
@@ -62,6 +67,7 @@ __all__ = [
     "init_sedov", "sedov_constants",
     "init_noh", "noh_constants",
     "init_evrard", "evrard_constants",
+    "init_evrard_cooling",
     "init_gresho_chan", "gresho_chan_constants",
     "init_isobaric_cube", "isobaric_cube_constants",
     "init_kelvin_helmholtz", "kelvin_helmholtz_constants",

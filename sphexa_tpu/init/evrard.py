@@ -25,6 +25,19 @@ def evrard_constants() -> Dict[str, float]:
     }
 
 
+def init_evrard_cooling(
+    side: int, overrides: Optional[Dict[str, float]] = None
+) -> Tuple[ParticleState, Box, SimConstants]:
+    """Evrard collapse with radiative cooling enabled (run with
+    --prop std-cooling); particle fields are identical to init_evrard.
+
+    The cooling unit system of the reference case (cooling::m_code_in_ms =
+    1e16, cooling::l_code_in_kpc = 46400, evrard_cooling_init.hpp:59-60) is
+    the single-source default of physics.cooling.CoolingConfig; customize
+    by passing Simulation(cooling_cfg=CoolingConfig(...))."""
+    return init_evrard(side, overrides)
+
+
 def init_evrard(
     side: int, overrides: Optional[Dict[str, float]] = None
 ) -> Tuple[ParticleState, Box, SimConstants]:

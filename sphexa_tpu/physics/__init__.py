@@ -1,0 +1,30 @@
+"""Physics extensions beyond pure hydrodynamics.
+
+Counterpart of the reference's ``physics/`` tree (GRACKLE radiative
+cooling wrapper). The TPU build ships a reduced, self-contained tabulated
+cooling model instead of the external C/Fortran GRACKLE library (SURVEY.md
+§7 stage 7) — same propagator coupling (cooling timestep limiter, du
+source term, chemistry-aware EOS), jit-compatible throughout.
+"""
+
+from sphexa_tpu.physics.cooling import (
+    ChemistryData,
+    CoolingConfig,
+    cool_particles,
+    cooling_rate,
+    cooling_timestep,
+    eos_cooling,
+    temp_to_u,
+    u_to_temp,
+)
+
+__all__ = [
+    "ChemistryData",
+    "CoolingConfig",
+    "cool_particles",
+    "cooling_rate",
+    "cooling_timestep",
+    "eos_cooling",
+    "temp_to_u",
+    "u_to_temp",
+]

@@ -133,17 +133,13 @@ def _integrate_and_finish(
     return new_state, box, diagnostics
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def step_hydro_std(
+def _std_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree] = None,
-) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
-    """One standard-SPH time step (std_hydro.hpp:123-175 sequence).
-
-    box regrow -> sort -> neighbors -> density -> EOS -> IAD ->
-    momentum/energy [-> gravity] -> timestep -> positions ->
-    smoothing-length update. Returns (new_state, new_box, diagnostics).
-    """
+    gtree: Optional[GravityTree],
+):
+    """The std-SPH force stage shared by the plain and cooling propagators
+    (HydroProp::computeForces, std_hydro.hpp:123-157): box regrow -> sort ->
+    neighbors -> density -> EOS -> IAD -> momentum/energy [-> gravity]."""
     const = cfg.const
     # grow open-boundary dims to fit drifted particles (box_mpi.hpp role);
     # box limits are traced values, so this never recompiles
@@ -170,7 +166,54 @@ def step_hydro_std(
         )
         extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
 
-    dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=const)
+    return (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ,
+            rho, c, gdiag)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def step_hydro_std(
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree] = None,
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
+    """One standard-SPH time step (std_hydro.hpp:123-175 sequence).
+
+    Force stage -> timestep -> positions -> smoothing-length update.
+    Returns (new_state, new_box, diagnostics).
+    """
+    (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
+     gdiag) = _std_forces(state, box, cfg, gtree)
+    dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=cfg.const)
+    return _integrate_and_finish(
+        state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
+        keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields, c=c,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "cool_cfg"))
+def step_hydro_std_cooling(
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree], chem, cool_cfg,
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
+    """One std-SPH step with radiative cooling
+    (HydroGrackleProp::step, std_hydro_grackle.hpp:193-233): force stage ->
+    timestep with the cooling-time limiter -> integrate the cooling source
+    into du -> positions -> smoothing-length update."""
+    from sphexa_tpu.physics.cooling import cool_particles, cooling_timestep
+
+    const = cfg.const
+    (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
+     gdiag) = _std_forces(state, box, cfg, gtree)
+
+    u = const.cv * state.temp
+    dt_cool = cooling_timestep(rho, u, chem, cool_cfg)
+    dt = compute_timestep(
+        state.min_dt, dt_courant, dt_cool, *extra_dts, const=const
+    )
+    du_cool = cool_particles(dt, rho, u, chem, cool_cfg)
+    du = du + du_cool
+
+    gdiag = {**(gdiag or {}), "dt_cool": dt_cool,
+             "du_cool_min": jnp.min(du_cool)}
     return _integrate_and_finish(
         state, box, const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
         keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields, c=c,
@@ -311,5 +354,5 @@ def step_nbody(
     return _integrate_and_finish(
         state, box, const, ax, ay, az, zero, dt, nc, jnp.int32(0), zero,
         extra_diag={**gdiag, "egrav": egrav}, update_smoothing=False,
-        keep_accels=cfg.keep_accels,
+        keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields,
     )

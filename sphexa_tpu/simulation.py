@@ -23,6 +23,7 @@ from sphexa_tpu.neighbors.cell_list import (
 from sphexa_tpu.propagator import (
     PropagatorConfig,
     step_hydro_std,
+    step_hydro_std_cooling,
     step_hydro_ve,
     step_nbody,
     step_turb_ve,
@@ -36,6 +37,7 @@ _PROPAGATORS: Dict[str, Callable] = {
     "ve": step_hydro_ve,
     "nbody": step_nbody,
     "turb-ve": step_turb_ve,
+    "std-cooling": step_hydro_std_cooling,
 }
 
 
@@ -89,6 +91,8 @@ class Simulation:
         turb_cfg=None,
         turb_state=None,
         turb_settings: Optional[Dict] = None,
+        cooling_cfg=None,
+        chem=None,
     ):
         self.state = state
         self.box = box
@@ -138,6 +142,16 @@ class Simulation:
             # fresh OU phases but keeps the derived static config
             if self.turb_state is None:
                 self.turb_state = fresh_state
+        # radiative cooling (std-cooling propagator): reduced CIE model
+        self.cooling_cfg = cooling_cfg
+        self.chem = chem
+        if prop == "std-cooling":
+            from sphexa_tpu.physics.cooling import ChemistryData, CoolingConfig
+
+            if self.cooling_cfg is None:
+                self.cooling_cfg = CoolingConfig(gamma=const.gamma)
+            if self.chem is None:
+                self.chem = ChemistryData.ionized(state.n)
         self.iteration = 0
         self._cfg: Optional[PropagatorConfig] = None
         self._gtree = None
@@ -213,6 +227,11 @@ class Simulation:
                 new_state, new_box, diagnostics, new_turb = step_fn(
                     self.state, self.box, self._cfg, self._gtree,
                     self.turb_state, self.turb_cfg,
+                )
+            elif self.prop_name == "std-cooling":
+                new_state, new_box, diagnostics = step_fn(
+                    self.state, self.box, self._cfg, self._gtree,
+                    self.chem, self.cooling_cfg,
                 )
             else:
                 new_state, new_box, diagnostics = step_fn(

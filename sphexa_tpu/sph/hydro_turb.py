@@ -66,6 +66,12 @@ def create_stirring_modes(
     mirrored +-ky/+-kz modes (create_modes.hpp:30-160), OU variance from
     the target Mach energy input rate.
     """
+    if spect_form not in (0, 1):
+        raise NotImplementedError(
+            "spect_form must be 0 (band) or 1 (parabolic); the reference's "
+            "power-law sampling (spectForm=2, create_modes.hpp:162+) is not "
+            "implemented"
+        )
     twopi = 2.0 * np.pi
     velocity = mach_velocity
     energy = energy_prefac * velocity**3 / lbox

@@ -28,7 +28,7 @@ class TestFactory:
     def test_all_cases_registered(self):
         assert set(CASES) == {
             "sedov", "noh", "evrard", "gresho-chan", "isobaric-cube",
-            "kelvin-helmholtz", "wind-shock", "turbulence",
+            "kelvin-helmholtz", "wind-shock", "turbulence", "evrard-cooling",
         }
 
     def test_unknown_case_raises(self):
