@@ -33,6 +33,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="propagator: std | ve | turb-ve | std-cooling | nbody")
     p.add_argument("--quiet", action="store_true")
     p.add_argument("--avclean", action="store_true")
+    p.add_argument("--theta", type=float, default=0.5,
+                   help="gravity MAC accuracy parameter [0.5]")
+    p.add_argument("--G", type=float, default=None, dest="grav_constant",
+                   help="gravitational constant override (enables gravity)")
+    p.add_argument("--glass", default=None,
+                   help="glass template file (accepted for compatibility; a "
+                        "procedural jittered lattice is used instead)")
+    p.add_argument("--wextra", default="",
+                   help="comma-separated extra output triggers: integers = "
+                        "iterations, floats = simulation times")
+    p.add_argument("--ascii", action="store_true",
+                   help="dump ASCII columns instead of HDF5 (not restartable)")
+    p.add_argument("--duration", type=float, default=None,
+                   help="maximum wall-clock run time in seconds; dumps a "
+                        "final snapshot before exiting if -w is enabled")
+    p.add_argument("--profile", action="store_true",
+                   help="save a per-iteration timing series to profile.npz")
     return p
 
 
@@ -91,6 +108,15 @@ def main(argv=None) -> int:
             return 2
         state, box, const = initializer(args.side)
 
+    if args.glass:
+        log(f"# --glass {args.glass} noted: the TPU build generates an "
+            "equivalent procedural jittered-lattice block (init/glass.py)")
+    if args.grav_constant is not None:
+        # --G overrides the case's gravitational constant (sphexa.cpp --G)
+        import dataclasses as _dc
+
+        const = _dc.replace(const, g=args.grav_constant)
+
     # observable selected by the test case (observables/factory.hpp:46-70) —
     # on restart, by the case name the snapshot recorded; field-consuming
     # observables read rho/c straight from the step diagnostics
@@ -98,7 +124,7 @@ def main(argv=None) -> int:
     sim = Simulation(state, box, const, prop=args.prop,
                      av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
                      turb_state=turb_state, turb_cfg=turb_cfg,
-                     keep_fields=observable.needs_fields)
+                     keep_fields=observable.needs_fields, theta=args.theta)
     log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
 
     # resuming from a snapshot continues the iteration numbering, and an
@@ -120,15 +146,39 @@ def main(argv=None) -> int:
     w_steps = int(w) if w > 0 and float(w).is_integer() else None
     w_time = w if w > 0 and w_steps is None else None
     next_dump_time = [float(state.ttot) + w_time] if w_time else None
-    if w > 0:
+    if w > 0 or args.wextra:
         case_tag = "".join(c if c.isalnum() else "_" for c in args.init)
-        dump_path = f"{args.out_dir}/dump_{case_tag}.h5"
-        if os.path.exists(dump_path):
-            print(f"# removing stale {dump_path} (would interleave old steps)",
-                  file=sys.stderr)
-            os.remove(dump_path)
+        ext = "txt" if args.ascii else "h5"
+        dump_path = f"{args.out_dir}/dump_{case_tag}.{ext}"
+        # drop leftovers of a previous run (would interleave old steps)
+        import glob as _glob
+
+        stale = (
+            _glob.glob(f"{args.out_dir}/dump_{case_tag}_it*.txt")
+            if args.ascii
+            else [dump_path] * os.path.exists(dump_path)
+        )
+        for f in stale:
+            print(f"# removing stale {f}", file=sys.stderr)
+            os.remove(f)
 
     want_fields = [f for f in args.out_fields.split(",") if f]
+
+    # --wextra: one-shot triggers, integers = iterations, floats = sim
+    # times (arg_parser.hpp isExtraOutputStep)
+    wextra_steps, wextra_times = set(), []
+    for tok in (t for t in args.wextra.split(",") if t):
+        try:
+            val = float(tok)
+        except ValueError:
+            print(f"--wextra: cannot parse {tok!r} (expected comma-separated "
+                  "integers or floats)", file=sys.stderr)
+            return 2
+        if val.is_integer() and "." not in tok:
+            wextra_steps.add(int(val))
+        else:
+            wextra_times.append(val)
+    wextra_times.sort()
 
     constants_path = f"{args.out_dir}/constants.txt"
     if not is_restart and os.path.exists(constants_path):
@@ -143,19 +193,13 @@ def main(argv=None) -> int:
         return compute_output_fields(sim.state, sim.box, sim._cfg,
                                      pipeline=pipeline)
 
-    def maybe_dump(it):
-        """Restartable snapshot on the -w schedule; derived fields are
-        recomputed like the reference's saveFields pass, consistently with
-        the active propagator."""
-        due = (w_steps is not None and it % w_steps == 0) or (
-            next_dump_time is not None and float(sim.state.ttot) >= next_dump_time[0]
-        )
-        if dump_path is None or not due:
-            return
-        if next_dump_time is not None:
-            next_dump_time[0] += w_time
-        from sphexa_tpu.io import write_snapshot
+    last_dump_iteration = [None]
 
+    def dump_now(it):
+        """Write one output (restartable HDF5 snapshot, or ASCII columns
+        with --ascii); derived fields are recomputed like the reference's
+        saveFields pass, consistently with the active propagator."""
+        last_dump_iteration[0] = it
         extra = output_fields()
         if want_fields:
             unknown = [f for f in want_fields if f not in extra]
@@ -163,6 +207,20 @@ def main(argv=None) -> int:
                 print(f"# -f fields not available, skipped: {unknown}",
                       file=sys.stderr)
             extra = {k: v for k, v in extra.items() if k in want_fields}
+
+        if args.ascii:
+            from sphexa_tpu.io import write_ascii
+            from sphexa_tpu.io.snapshot import CONSERVED_FIELDS
+
+            cols = {f: np.asarray(getattr(sim.state, f)) for f in CONSERVED_FIELDS}
+            cols.update(extra)
+            path = dump_path.replace(".txt", f"_it{it}.txt")
+            write_ascii(path, cols)
+            log(f"# wrote ASCII dump -> {path} (not restartable)")
+            return
+
+        from sphexa_tpu.io import write_snapshot
+
         if sim.turb_state is not None:
             from sphexa_tpu.sph.hydro_turb import turbulence_state_to_fields
 
@@ -176,15 +234,45 @@ def main(argv=None) -> int:
         )
         log(f"# wrote Step#{step} -> {dump_path}")
 
+    def maybe_dump(it):
+        """-w schedule + --wextra one-shot triggers."""
+        if dump_path is None:
+            return
+        t_now = float(sim.state.ttot)
+        due = (w_steps is not None and it % w_steps == 0) or (
+            next_dump_time is not None and t_now >= next_dump_time[0]
+        )
+        if it in wextra_steps:
+            due = True
+        while wextra_times and t_now >= wextra_times[0]:
+            wextra_times.pop(0)
+            due = True
+        if not due:
+            return
+        if next_dump_time is not None and t_now >= next_dump_time[0]:
+            next_dump_time[0] += w_time
+        dump_now(it)
+
+    from sphexa_tpu.util.timer import ProfileRecorder, Timer
+
+    timer = Timer()
+    profile = ProfileRecorder()
     t0 = time.time()
     it0 = sim.iteration
     while True:
+        timer.start()
         d = sim.step()
+        timer.step("step")
         it = sim.iteration
         e = conserved_quantities(sim.state, const, egrav=d.get("egrav", 0.0))
         fields = {"rho": d["rho"], "c": d["c"]} if observable.needs_fields else None
         row = constants.write(it, sim.state, sim.box, e, fields)
+        timer.step("observables")
         maybe_dump(it)  # dumps recompute the full derived set (r, p, u, ...)
+        timer.step("output")
+        if args.profile:
+            profile.record(it, timer.pop(), dt=float(d["dt"]),
+                           nc_mean=float(d["nc_mean"]))
         extra_cols = " ".join(
             f"{n}={v:.4g}" for n, v in zip(observable.extra_columns, row[7:])
         )
@@ -198,8 +286,23 @@ def main(argv=None) -> int:
             break
         if target_time is not None and float(sim.state.ttot) >= target_time:
             break
+        if args.duration is not None and time.time() - t0 >= args.duration:
+            # graceful wall-clock cutoff with a final restartable dump
+            # (sphexa.cpp:153-173 --duration semantics)
+            log(f"# wall-clock limit {args.duration}s reached at iteration {it}")
+            if dump_path is not None and last_dump_iteration[0] != it:
+                dump_now(it)
+            break
     dt_wall = time.time() - t0
     n_done = sim.iteration - it0
+    if args.profile:
+        profile_path = f"{args.out_dir}/profile.npz"
+        profile.save(profile_path)
+        means = profile.summary()
+        log("# profile (mean s/iter): "
+            + " ".join(f"{k}={v:.4f}" for k, v in means.items()
+                       if k in ("step", "observables", "output")))
+        log(f"# timing series -> {profile_path}")
     log(f"# {n_done} iterations in {dt_wall:.2f}s "
         f"({state.n * n_done / dt_wall / 1e6:.3f}M particle-updates/s)")
     return 0

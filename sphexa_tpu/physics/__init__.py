@@ -4,7 +4,8 @@ Counterpart of the reference's ``physics/`` tree (GRACKLE radiative
 cooling wrapper). The TPU build ships a reduced, self-contained tabulated
 cooling model instead of the external C/Fortran GRACKLE library (SURVEY.md
 §7 stage 7) — same propagator coupling (cooling timestep limiter, du
-source term, chemistry-aware EOS), jit-compatible throughout.
+source term; under the CIE closure the EOS reduces to the ideal-gas form,
+see eos_cooling), jit-compatible throughout.
 """
 
 from sphexa_tpu.physics.cooling import (

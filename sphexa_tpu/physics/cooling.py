@@ -222,9 +222,16 @@ def cool_particles(dt, rho_code, u_code, chem: ChemistryData, cfg: CoolingConfig
 
 
 def eos_cooling(rho_code, u_code, chem: ChemistryData, cfg: CoolingConfig):
-    """Chemistry-aware EOS: p and c from the composition's mu
-    (eos_cooling.hpp:27-47). With the CIE closure gamma stays cfg.gamma;
-    mu enters through the temperature, p = (gamma-1) rho u directly."""
+    """EOS used by the cooling propagator's contract (eos_cooling.hpp:27-47).
+
+    Under the CIE closure the composition enters only through the u <-> T
+    conversion (mean molecular weight); pressure from specific internal
+    energy is exactly the ideal-gas form p = (gamma-1) rho u, which is what
+    the force stage (hydro_std.compute_eos_std) already evaluates — so the
+    propagator needs no separate EOS hook. This function exists as the
+    explicit statement of that identity (and the place a future
+    variable-gamma chemistry model would plug in)."""
+    del chem  # composition-independent under the CIE closure
     p = (cfg.gamma - 1.0) * rho_code * u_code
     c = jnp.sqrt(cfg.gamma * p / rho_code)
     return p, c

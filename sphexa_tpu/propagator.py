@@ -62,10 +62,12 @@ class PropagatorConfig:
     keep_fields: bool = False
 
 
-def _sort_by_keys(state: ParticleState, box: Box, curve: str):
+def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
     """Global SFC sort: the analog of domain.sync()'s keygen + radix sort
     (cstone/domain/assignment.hpp:84-122). Every field array is gathered
-    into key order; scalars pass through untouched.
+    into key order; scalars pass through untouched. ``aux``: an optional
+    extra pytree of per-particle arrays (e.g. ChemistryData) permuted
+    identically so it stays aligned with the persisted sorted state.
     """
     keys = compute_sfc_keys(state.x, state.y, state.z, box, curve=curve)
     order = jnp.argsort(keys)
@@ -74,7 +76,10 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str):
     def maybe_gather(leaf):
         return leaf[order] if leaf.ndim == 1 and leaf.shape[0] == state.n else leaf
 
-    return jax.tree.map(maybe_gather, state), sorted_keys
+    sorted_state = jax.tree.map(maybe_gather, state)
+    if aux is None:
+        return sorted_state, sorted_keys
+    return sorted_state, sorted_keys, jax.tree.map(maybe_gather, aux)
 
 
 def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
@@ -135,16 +140,19 @@ def _integrate_and_finish(
 
 def _std_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree],
+    gtree: Optional[GravityTree], aux=None,
 ):
     """The std-SPH force stage shared by the plain and cooling propagators
     (HydroProp::computeForces, std_hydro.hpp:123-157): box regrow -> sort ->
-    neighbors -> density -> EOS -> IAD -> momentum/energy [-> gravity]."""
+    neighbors -> density -> EOS -> IAD -> momentum/energy [-> gravity].
+    ``aux`` is an optional per-particle pytree sorted along with the state
+    and returned last."""
     const = cfg.const
     # grow open-boundary dims to fit drifted particles (box_mpi.hpp role);
     # box limits are traced values, so this never recompiles
     box = make_global_box(state.x, state.y, state.z, box)
-    state, keys = _sort_by_keys(state, box, cfg.curve)
+    state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=(aux,))
+    aux = aux[0]
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 
     nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
@@ -167,7 +175,7 @@ def _std_forces(
         extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
 
     return (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ,
-            rho, c, gdiag)
+            rho, c, gdiag, aux)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -181,7 +189,7 @@ def step_hydro_std(
     Returns (new_state, new_box, diagnostics).
     """
     (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
-     gdiag) = _std_forces(state, box, cfg, gtree)
+     gdiag, _) = _std_forces(state, box, cfg, gtree)
     dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=cfg.const)
     return _integrate_and_finish(
         state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
@@ -193,16 +201,20 @@ def step_hydro_std(
 def step_hydro_std_cooling(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree], chem, cool_cfg,
-) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array], object]:
     """One std-SPH step with radiative cooling
     (HydroGrackleProp::step, std_hydro_grackle.hpp:193-233): force stage ->
     timestep with the cooling-time limiter -> integrate the cooling source
-    into du -> positions -> smoothing-length update."""
+    into du -> positions -> smoothing-length update.
+
+    The per-particle chemistry rides the step's SFC sort and the permuted
+    ChemistryData is returned so it stays aligned with the persisted state.
+    """
     from sphexa_tpu.physics.cooling import cool_particles, cooling_timestep
 
     const = cfg.const
     (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
-     gdiag) = _std_forces(state, box, cfg, gtree)
+     gdiag, chem) = _std_forces(state, box, cfg, gtree, aux=chem)
 
     u = const.cv * state.temp
     dt_cool = cooling_timestep(rho, u, chem, cool_cfg)
@@ -214,10 +226,11 @@ def step_hydro_std_cooling(
 
     gdiag = {**(gdiag or {}), "dt_cool": dt_cool,
              "du_cool_min": jnp.min(du_cool)}
-    return _integrate_and_finish(
+    new_state, box, diag = _integrate_and_finish(
         state, box, const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
         keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields, c=c,
     )
+    return new_state, box, diag, chem
 
 
 def _ve_forces(

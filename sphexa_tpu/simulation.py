@@ -222,14 +222,14 @@ class Simulation:
         reconfigured = False
         grav_margin = 1.5
         for _attempt in range(3):
-            new_turb = None
+            new_turb, new_chem = None, None
             if self.prop_name == "turb-ve":
                 new_state, new_box, diagnostics, new_turb = step_fn(
                     self.state, self.box, self._cfg, self._gtree,
                     self.turb_state, self.turb_cfg,
                 )
             elif self.prop_name == "std-cooling":
-                new_state, new_box, diagnostics = step_fn(
+                new_state, new_box, diagnostics, new_chem = step_fn(
                     self.state, self.box, self._cfg, self._gtree,
                     self.chem, self.cooling_cfg,
                 )
@@ -252,6 +252,8 @@ class Simulation:
         self.box = new_box
         if new_turb is not None:
             self.turb_state = new_turb
+        if new_chem is not None:
+            self.chem = new_chem
         self.iteration += 1
         if not self._config_still_valid(diagnostics):
             self._configure()

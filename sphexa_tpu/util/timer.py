@@ -1,0 +1,68 @@
+"""Wall-clock phase timing + profile series.
+
+Counterpart of the reference's ``main/src/util/timer.hpp`` (per-substep
+Timer printed each iteration, dumpable as a timing series with --profile,
+ipropagator.hpp:80-119). The TPU step is one fused XLA program, so the
+measurable phases are coarser: step (device compute incl. any recompile),
+observables, output. The profile dump is an npz timeseries instead of the
+reference's HDF5 group.
+"""
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+class Timer:
+    """Accumulates named wall-clock laps within one iteration."""
+
+    def __init__(self):
+        self.laps: Dict[str, float] = {}
+        self._t = time.perf_counter()
+
+    def start(self):
+        self._t = time.perf_counter()
+
+    def step(self, name: str) -> float:
+        """Record time since the last mark under ``name`` (timer.hpp:46)."""
+        now = time.perf_counter()
+        elapsed = now - self._t
+        self.laps[name] = self.laps.get(name, 0.0) + elapsed
+        self._t = now
+        return elapsed
+
+    def pop(self) -> Dict[str, float]:
+        out = self.laps
+        self.laps = {}
+        return out
+
+
+class ProfileRecorder:
+    """Per-iteration timing/metric rows; saved with --profile
+    (ipropagator.hpp:83-87 writes the analogous HDF5 series)."""
+
+    def __init__(self):
+        self.rows: List[Dict[str, float]] = []
+
+    def record(self, iteration: int, laps: Dict[str, float], **metrics):
+        self.rows.append({"iteration": float(iteration), **laps, **metrics})
+
+    def save(self, path: str):
+        if not self.rows:
+            return
+        keys = sorted({k for row in self.rows for k in row})
+        arrays = {
+            k: np.array([row.get(k, np.nan) for row in self.rows]) for k in keys
+        }
+        np.savez(path, **arrays)
+
+    def summary(self) -> Dict[str, float]:
+        """Mean seconds per iteration for each recorded phase."""
+        if not self.rows:
+            return {}
+        keys = {k for row in self.rows for k in row} - {"iteration"}
+        return {
+            k: float(np.nanmean([row.get(k, np.nan) for row in self.rows]))
+            for k in sorted(keys)
+        }

@@ -122,6 +122,45 @@ class TestIntegrator:
         )
 
 
+class TestChemAlignment:
+    def test_chem_rides_the_sfc_sort(self):
+        """Per-particle chemistry must stay aligned with the particles
+        through the step's internal SFC sort: tag each particle's metal
+        fraction with its initial x-coordinate rank and check the pairing
+        survives a step."""
+        import dataclasses as dc
+
+        from sphexa_tpu.init import init_sedov
+        from sphexa_tpu.simulation import Simulation
+
+        state, box, const = init_sedov(8)
+        n = state.n
+        # shuffle the particle order so the step's SFC sort is a
+        # nontrivial permutation
+        perm = np.random.default_rng(7).permutation(n)
+        state = dc.replace(
+            state,
+            **{f: jnp.asarray(np.asarray(getattr(state, f))[perm])
+               for f in ("x", "y", "z", "vx", "vy", "vz", "h", "m", "temp")},
+        )
+        # tag: affine in the (pre-step) position; from rest, two tiny steps
+        # move particles by ~dt^2, so the relation survives if and only if
+        # chem rides the same permutation as the coordinates
+        tag = 0.01 + 0.005 * (np.asarray(state.x) + 0.5)
+        chem = ChemistryData.ionized(n)
+        chem = dc.replace(chem, metal=jnp.asarray(tag.astype(np.float32)))
+
+        sim = Simulation(state, box, const, prop="std-cooling", block=256,
+                         chem=chem)
+        sim.step()
+        sim.step()
+        x_now = np.asarray(sim.state.x)
+        metal_now = np.asarray(sim.chem.metal)
+        np.testing.assert_allclose(
+            metal_now, 0.01 + 0.005 * (x_now + 0.5), atol=1e-5
+        )
+
+
 class TestCoolingPropagator:
     def test_evrard_cooling_run(self):
         from sphexa_tpu.init import make_initializer
