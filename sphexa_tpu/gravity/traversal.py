@@ -164,24 +164,40 @@ def compute_multipoles(
     return node_mass, node_com, node_q, edges
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "cfg"))
+@functools.partial(jax.jit, static_argnames=("meta", "cfg", "with_phi"))
 def compute_gravity(
     x, y, z, m, h, sorted_keys, box: Box,
     tree: GravityTree, meta: GravityTreeMeta, cfg: GravityConfig,
+    shift=None, allow_self=None, with_phi: bool = False, mp_cache=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
     """Gravitational acceleration + potential for all (SFC-sorted) particles.
 
-    Returns (ax, ay, az, egrav, diagnostics). Diagnostics report the
-    high-water interaction-list occupancies; if any exceeds its cap the
-    caller must enlarge the config and re-run (Simulation handles this the
-    same way as neighbor-cell overflow).
+    Returns (ax, ay, az, egrav, diagnostics) — or (..., phi, diagnostics)
+    when ``with_phi`` — where diagnostics report the high-water
+    interaction-list occupancies; if any exceeds its cap the caller must
+    enlarge the config and re-run (Simulation handles this the same way as
+    neighbor-cell overflow).
+
+    ``shift``: optional (3,) offset added to the *target* positions — the
+    replica-shell evaluation of periodic gravity (targets against the
+    tree of the base box, traversal_cpu.hpp computeGravity numReplicaShells).
+    ``allow_self`` (traced bool scalar) must be True for nonzero shifts: a
+    particle does interact with its own periodic image. Both are traced so
+    the Ewald replica loop compiles this function once.
+    ``mp_cache``: optional precomputed compute_multipoles result.
     """
     n = x.shape[0]
     num_n = meta.num_nodes
-    node_mass, node_com, node_q, edges = compute_multipoles(
-        x, y, z, m, sorted_keys, tree, meta
+    node_mass, node_com, node_q, edges = (
+        mp_cache
+        if mp_cache is not None
+        else compute_multipoles(x, y, z, m, sorted_keys, tree, meta)
     )
     valid = node_mass > 0.0
+    if shift is None:
+        shift = jnp.zeros(3, x.dtype)
+    if allow_self is None:
+        allow_self = jnp.asarray(False)
 
     lengths = box.lengths  # (3,)
     lo = jnp.stack([box.lo[0], box.lo[1], box.lo[2]])
@@ -204,7 +220,7 @@ def compute_gravity(
 
     def one_block(bi):
         """bi: (blk,) particle indices of one target group."""
-        tx, ty, tz, th = x[bi], y[bi], z[bi], h[bi]
+        tx, ty, tz, th = x[bi] + shift[0], y[bi] + shift[1], z[bi] + shift[2], h[bi]
         bc = jnp.stack(
             [(jnp.max(tx) + jnp.min(tx)) * 0.5,
              (jnp.max(ty) + jnp.min(ty)) * 0.5,
@@ -246,7 +262,8 @@ def compute_gravity(
         cand_ok = (cand < end[:, None]) & p2p_ok[:, None]
         cand = jnp.clip(cand, 0, n - 1).reshape(-1)  # (P*C,)
         cand_ok = cand_ok.reshape(-1)
-        pair_ok = cand_ok[None, :] & (cand[None, :] != bi[:, None])
+        # in a shifted replica pass a particle's own image is a real pair
+        pair_ok = cand_ok[None, :] & ((cand[None, :] != bi[:, None]) | allow_self)
         pax, pay, paz, pphi = mp.p2p(
             tx, ty, tz, th,
             x[cand], y[cand], z[cand], m[cand], h[cand], pair_ok,
@@ -263,10 +280,12 @@ def compute_gravity(
     phi = phi.reshape(-1)[:n] * cfg.G
     # padded tail lanes duplicate the last particle; only [:n] is kept, and
     # egrav sums the trimmed arrays, so duplicates never double-count.
-    egrav = 0.5 * jnp.sum(m * phi)
     diagnostics = {
         "m2p_max": jnp.max(m2p_n),
         "p2p_max": jnp.max(p2p_n),
         "leaf_occ": leaf_occ,
     }
+    if with_phi:
+        return ax, ay, az, phi, diagnostics
+    egrav = 0.5 * jnp.sum(m * phi)
     return ax, ay, az, egrav, diagnostics

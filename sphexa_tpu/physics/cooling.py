@@ -231,7 +231,7 @@ def eos_cooling(rho_code, u_code, chem: ChemistryData, cfg: CoolingConfig):
     propagator needs no separate EOS hook. This function exists as the
     explicit statement of that identity (and the place a future
     variable-gamma chemistry model would plug in)."""
+    from sphexa_tpu.sph.eos import ideal_gas_eos_u
+
     del chem  # composition-independent under the CIE closure
-    p = (cfg.gamma - 1.0) * rho_code * u_code
-    c = jnp.sqrt(cfg.gamma * p / rho_code)
-    return p, c
+    return ideal_gas_eos_u(u_code, rho_code, cfg.gamma)

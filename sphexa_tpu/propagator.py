@@ -19,6 +19,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from sphexa_tpu.gravity.ewald import EwaldConfig, compute_gravity_ewald
 from sphexa_tpu.gravity.traversal import GravityConfig, compute_gravity
 from sphexa_tpu.gravity.tree import GravityTree, GravityTreeMeta
 from sphexa_tpu.neighbors.cell_list import NeighborConfig, find_neighbors
@@ -53,6 +54,9 @@ class PropagatorConfig:
     av_clean: bool = False
     gravity: Optional[GravityConfig] = None
     grav_meta: Optional[GravityTreeMeta] = None
+    # periodic-box gravity: when set, the Barnes-Hut solve goes through the
+    # Ewald path (replica near field + real/k-space corrections)
+    ewald: Optional[EwaldConfig] = None
     # include the per-particle accelerations in the step diagnostics (the
     # gravitational-wave observable consumes them, gravitational_waves.hpp)
     keep_accels: bool = False
@@ -76,10 +80,12 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
     def maybe_gather(leaf):
         return leaf[order] if leaf.ndim == 1 and leaf.shape[0] == state.n else leaf
 
-    sorted_state = jax.tree.map(maybe_gather, state)
-    if aux is None:
-        return sorted_state, sorted_keys
-    return sorted_state, sorted_keys, jax.tree.map(maybe_gather, aux)
+    # jax.tree.map(None) -> None, so a missing aux passes through cleanly
+    return (
+        jax.tree.map(maybe_gather, state),
+        sorted_keys,
+        jax.tree.map(maybe_gather, aux),
+    )
 
 
 def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
@@ -91,10 +97,16 @@ def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
     egrav, the acceleration dt candidate, and solver diagnostics.
     """
     gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g)
-    gx, gy, gz, egrav, gdiag = compute_gravity(
-        state.x, state.y, state.z, state.m, state.h, keys, box,
-        gtree, cfg.grav_meta, gcfg,
-    )
+    if cfg.ewald is not None:
+        gx, gy, gz, egrav, gdiag = compute_gravity_ewald(
+            state.x, state.y, state.z, state.m, state.h, keys, box,
+            gtree, cfg.grav_meta, gcfg, cfg.ewald,
+        )
+    else:
+        gx, gy, gz, egrav, gdiag = compute_gravity(
+            state.x, state.y, state.z, state.m, state.h, keys, box,
+            gtree, cfg.grav_meta, gcfg,
+        )
     ax, ay, az = ax + gx, ay + gy, az + gz
     dt_acc = acceleration_timestep(ax, ay, az, cfg.const)
     return ax, ay, az, egrav, dt_acc, gdiag
@@ -151,8 +163,7 @@ def _std_forces(
     # grow open-boundary dims to fit drifted particles (box_mpi.hpp role);
     # box limits are traced values, so this never recompiles
     box = make_global_box(state.x, state.y, state.z, box)
-    state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=(aux,))
-    aux = aux[0]
+    state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 
     nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
@@ -245,7 +256,7 @@ def _ve_forces(
     """
     const = cfg.const
     box = make_global_box(state.x, state.y, state.z, box)
-    state, keys = _sort_by_keys(state, box, cfg.curve)
+    state, keys, _ = _sort_by_keys(state, box, cfg.curve)
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
     vx, vy, vz = state.vx, state.vy, state.vz
 
@@ -355,7 +366,7 @@ def step_nbody(
     """
     const = cfg.const
     box = make_global_box(state.x, state.y, state.z, box)
-    state, keys = _sort_by_keys(state, box, cfg.curve)
+    state, keys, _ = _sort_by_keys(state, box, cfg.curve)
 
     zero = jnp.zeros_like(state.x)
     ax, ay, az, egrav, dt_acc, gdiag = _add_gravity(

@@ -111,14 +111,22 @@ class Simulation:
                 "prop='nbody' needs a gravitational constant: set SimConstants(g=...)"
             )
         self.gravity_on = const.g != 0.0
-        if self.gravity_on and any(
-            b == BoundaryType.periodic for b in box.boundaries
-        ):
+        any_periodic = any(b == BoundaryType.periodic for b in box.boundaries)
+        all_periodic = all(b == BoundaryType.periodic for b in box.boundaries)
+        self.ewald_on = self.gravity_on and all_periodic
+        if self.gravity_on and any_periodic and not all_periodic:
             raise NotImplementedError(
-                "self-gravity in a periodic box needs the Ewald solver "
-                "(traversal_ewald_cpu.hpp analog), which is not wired in yet; "
-                "use open boundaries"
+                "self-gravity supports fully periodic (Ewald) or fully "
+                "open boundaries, not mixed ones (same restriction as the "
+                "reference's computeGravityEwald)"
             )
+        if self.ewald_on:
+            lx = np.asarray(box.lengths)
+            if not np.allclose(lx, lx[0]):
+                raise ValueError(
+                    "Ewald gravity requires a cubic periodic box "
+                    "(traversal_ewald_cpu.hpp:366)"
+                )
         # turbulence stirring state (turb-ve propagator): built from the
         # case settings unless an explicit (cfg, state) pair is given,
         # e.g. restored from a checkpoint
@@ -191,7 +199,14 @@ class Simulation:
             margin=margin,
         )
         self._gtree = gtree
-        self._cfg = dataclasses.replace(self._cfg, gravity=gcfg, grav_meta=meta)
+        ewald = None
+        if self.ewald_on:
+            from sphexa_tpu.gravity.ewald import EwaldConfig
+
+            ewald = EwaldConfig()
+        self._cfg = dataclasses.replace(
+            self._cfg, gravity=gcfg, grav_meta=meta, ewald=ewald
+        )
 
     def _gravity_overflowed(self, diagnostics) -> bool:
         if not self.gravity_on:

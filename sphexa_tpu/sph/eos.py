@@ -1,0 +1,38 @@
+"""Equations of state.
+
+Counterpart of the reference's ``sph/include/sph/eos.hpp``: the
+temperature-based and u-based ideal gas forms and the polytropic
+neutron-star EOS. The std/VE pipelines call their fused variants in
+hydro_std/hydro_ve; this module is the standalone catalog.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.sph.particles import SimConstants, ideal_gas_cv
+
+# Kpol for a 1.4 M_sun, 12.8 km neutron star (eos.hpp:52-53); not valid
+# for other masses/radii
+KPOL_NS = 2.246341237993810232e-10
+GAMMA_POL = 3.0
+
+
+def ideal_gas_eos(temp, rho, mui: float, gamma: float) -> Tuple[jax.Array, jax.Array]:
+    """(p, c) from temperature (eos.hpp:31-41)."""
+    tmp = ideal_gas_cv(mui, gamma) * temp * (gamma - 1.0)
+    return rho * tmp, jnp.sqrt(tmp)
+
+
+def ideal_gas_eos_u(u, rho, gamma: float) -> Tuple[jax.Array, jax.Array]:
+    """(p, c) from specific internal energy: p = (gamma-1) rho u."""
+    tmp = u * (gamma - 1.0)
+    return rho * tmp, jnp.sqrt(gamma * tmp)
+
+
+def polytropic_eos(rho, k_pol: float = KPOL_NS, gamma_pol: float = GAMMA_POL):
+    """(p, c) for a polytrope p = K rho^Gamma (eos.hpp:43-60)."""
+    p = k_pol * rho**gamma_pol
+    c = jnp.sqrt(gamma_pol * p / jnp.maximum(rho, 1e-30))
+    return p, c

@@ -1,0 +1,208 @@
+"""Ewald periodic-gravity tests + polytropic EOS.
+
+Correctness strategy mirrors ryoanji/test/nbody/ewald_cpu.cpp's intent,
+adapted to properties that are exact regardless of tuning: zero net force
+(momentum), lattice symmetry, translation invariance, and independence of
+the Ewald splitting parameter alpha (real/k-space decomposition must sum
+to the same total).
+"""
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sphexa_tpu.gravity.ewald import EwaldConfig, compute_gravity_ewald
+from sphexa_tpu.gravity.traversal import GravityConfig, estimate_gravity_caps
+from sphexa_tpu.gravity.tree import build_gravity_tree
+from sphexa_tpu.sfc.box import BoundaryType, Box
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+from sphexa_tpu.sph.eos import ideal_gas_eos_u, polytropic_eos
+
+
+def _setup(x, y, z, m, box, theta=0.6, bucket=32):
+    """Sort by SFC keys and build the gravity tree (Simulation._configure_gravity)."""
+    keys = np.asarray(compute_sfc_keys(x, y, z, box))
+    order = np.argsort(keys)
+    xs, ys, zs, ms = (jnp.asarray(np.asarray(a)[order]) for a in (x, y, z, m))
+    skeys = jnp.asarray(keys[order])
+    gtree, meta = build_gravity_tree(keys[order], bucket_size=bucket)
+    cfg = estimate_gravity_caps(
+        xs, ys, zs, ms, skeys, box, gtree, meta,
+        GravityConfig(theta=theta, bucket_size=bucket, G=1.0), margin=2.0,
+    )
+    return xs, ys, zs, ms, skeys, gtree, meta, cfg
+
+
+def _ewald_accels(x, y, z, m, box, ecfg=None, **kw):
+    xs, ys, zs, ms, skeys, gtree, meta, cfg = _setup(x, y, z, m, box, **kw)
+    h = jnp.full_like(xs, 1e-3)
+    ax, ay, az, egrav, diag = compute_gravity_ewald(
+        xs, ys, zs, ms, h, skeys, box, gtree, meta, cfg,
+        ecfg or EwaldConfig(),
+    )
+    assert int(diag["m2p_max"]) <= cfg.m2p_cap
+    assert int(diag["p2p_max"]) <= cfg.p2p_cap
+    return (np.asarray(ms), np.asarray(ax), np.asarray(ay), np.asarray(az),
+            float(egrav))
+
+
+@pytest.fixture(scope="module")
+def random_config():
+    rng = np.random.default_rng(5)
+    n = 128
+    x, y, z = rng.uniform(-0.5, 0.5, (3, n)).astype(np.float32)
+    m = rng.uniform(0.5, 1.5, n).astype(np.float32)
+    box = Box.create(-0.5, 0.5, boundary=BoundaryType.periodic)
+    return x, y, z, m, box
+
+
+class TestEwald:
+    def test_momentum_conservation(self, random_config):
+        x, y, z, m, box = random_config
+        ms, ax, ay, az, _ = _ewald_accels(x, y, z, m, box)
+        scale = np.sum(ms * np.sqrt(ax**2 + ay**2 + az**2))
+        for a in (ax, ay, az):
+            assert abs(np.sum(ms * a)) / scale < 0.05
+
+    def test_cubic_lattice_forces_vanish(self):
+        # perfectly symmetric periodic lattice: every particle's force ~ 0
+        side = 4
+        line = (np.arange(side) + 0.5) / side - 0.5
+        zz, yy, xx = np.meshgrid(line, line, line, indexing="ij")
+        x, y, z = (a.ravel().astype(np.float32) for a in (xx, yy, zz))
+        m = np.ones(side**3, np.float32)
+        box = Box.create(-0.5, 0.5, boundary=BoundaryType.periodic)
+        _, ax, ay, az, _ = _ewald_accels(x, y, z, m, box)
+        # compare against the force scale of a single neighbor pair
+        pair_scale = 1.0 / (1.0 / side) ** 2
+        for a in (ax, ay, az):
+            assert np.abs(a).max() / pair_scale < 0.02
+
+    def test_forces_match_particle_level_ewald(self, random_config):
+        """The gold test (the role of ryoanji's ewald_cpu.cpp reference
+        values): compare against a float64 particle-level Ewald sum."""
+        scipy_special = pytest.importorskip("scipy.special")
+        x, y, z, m, box = random_config
+        x, y, z, m = x[:64], y[:64], z[:64], m[:64]
+
+        def brute(alpha=4.0, nshell=4, kmax=8):
+            from itertools import product as iproduct
+
+            pos = np.stack([x, y, z], axis=1).astype(np.float64)
+            acc = np.zeros((len(m), 3))
+            for nx, ny, nz in iproduct(range(-nshell, nshell + 1), repeat=3):
+                R = pos[None, :, :] - pos[:, None, :] + np.array([nx, ny, nz])
+                r2 = (R**2).sum(-1)
+                if nx == ny == nz == 0:
+                    np.fill_diagonal(r2, np.inf)
+                r = np.sqrt(r2)
+                f = (
+                    scipy_special.erfc(alpha * r) / (r * r2)
+                    + 2 * alpha / np.sqrt(np.pi) * np.exp(-(alpha**2) * r2) / r2
+                )
+                acc += (m[None, :, None] * f[:, :, None] * R).sum(axis=1)
+            for hx, hy, hz in iproduct(range(-kmax, kmax + 1), repeat=3):
+                h2 = hx * hx + hy * hy + hz * hz
+                if h2 == 0 or h2 > kmax * kmax:
+                    continue
+                k = 2 * np.pi * np.array([hx, hy, hz])
+                k2 = (k**2).sum()
+                sc = (m * np.cos(pos @ k)).sum()
+                ss = (m * np.sin(pos @ k)).sum()
+                coef = 4 * np.pi / k2 * np.exp(-k2 / (4 * alpha**2))
+                ph = pos @ k
+                acc += coef * (-np.sin(ph) * sc + np.cos(ph) * ss)[:, None] * k[None, :]
+            return acc
+
+        from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+        keys = np.asarray(compute_sfc_keys(x, y, z, box))
+        order = np.argsort(keys)
+        a_ref = brute()[order]
+        _, ax, ay, az, _ = _ewald_accels(x, y, z, m, box)
+        a_ours = np.stack([ax, ay, az], axis=1)
+        scale = np.linalg.norm(a_ref, axis=1).mean()
+        err = np.linalg.norm(a_ours - a_ref, axis=1) / scale
+        assert err.mean() < 0.01, err.mean()
+        assert err.max() < 0.05, err.max()
+
+    def test_translation_invariance_of_forces(self, random_config):
+        """The force field is translation invariant (the potential's Ewald
+        constant is window-dependent at quadrupole truncation — same
+        property as the reference — so only forces are compared)."""
+        x, y, z, m, box = random_config
+        _, ax0, ay0, az0, _ = _ewald_accels(x, y, z, m, box)
+        shift = np.float32(0.2371)
+        xs = ((x + shift + 0.5) % 1.0) - 0.5
+        ys = ((y - shift + 0.5) % 1.0) - 0.5
+        _, ax1, ay1, az1, _ = _ewald_accels(xs, ys, z, m, box)
+        # particle identity is lost to the internal sort; compare the
+        # sorted force-magnitude spectrum
+        f0 = np.sort(np.sqrt(ax0**2 + ay0**2 + az0**2))
+        f1 = np.sort(np.sqrt(ax1**2 + ay1**2 + az1**2))
+        np.testing.assert_allclose(f1, f0, rtol=5e-2, atol=3e-2 * f0.max())
+
+    def test_alpha_independence(self, random_config):
+        """The real/k-space split must not change the force field: run with
+        two different splitting parameters and compare."""
+        x, y, z, m, box = random_config
+        e_a = EwaldConfig(alpha_scale=2.0, lcut=2.6, hcut=2.8)
+        e_b = EwaldConfig(alpha_scale=2.5, lcut=3.2, hcut=3.4)
+        _, ax0, ay0, az0, _ = _ewald_accels(x, y, z, m, box, ecfg=e_a)
+        _, ax1, ay1, az1, _ = _ewald_accels(x, y, z, m, box, ecfg=e_b)
+        scale = np.abs(ax0).max()
+        np.testing.assert_allclose(ax1, ax0, atol=2e-2 * scale)
+        np.testing.assert_allclose(az1, az0, atol=2e-2 * scale)
+
+    def test_periodic_differs_from_open(self, random_config):
+        """Periodic images must contribute: Ewald forces differ from the
+        open-boundary Barnes-Hut forces of the same configuration."""
+        from sphexa_tpu.gravity.traversal import compute_gravity
+
+        x, y, z, m, box = random_config
+        xs, ys, zs, ms, skeys, gtree, meta, cfg = _setup(x, y, z, m, box)
+        h = jnp.full_like(xs, 1e-3)
+        ax_o, *_ = compute_gravity(xs, ys, zs, ms, h, skeys, box, gtree, meta, cfg)
+        _, ax_e, *_ = _ewald_accels(x, y, z, m, box)
+        assert np.abs(ax_e - np.asarray(ax_o)).max() > 1e-3 * np.abs(ax_o).max()
+
+
+class TestSedovGravityEwald:
+    def test_periodic_gravity_run(self):
+        """A periodic case with gravity enabled now runs through the Ewald
+        path (previously NotImplementedError)."""
+        from sphexa_tpu.init import init_sedov
+        from sphexa_tpu.simulation import Simulation
+
+        state, box, const = init_sedov(8, overrides={"gravConstant": 0.5})
+        import dataclasses as dc
+
+        sim = Simulation(state, box, const, prop="std", block=256)
+        assert sim.ewald_on
+        d = sim.step()
+        # egrav's sign is convention-dependent for a near-uniform periodic
+        # box (window-dependent Ewald constant); finiteness + stability are
+        # the contract here
+        assert np.isfinite(d["egrav"])
+        assert np.all(np.isfinite(np.asarray(sim.state.vx)))
+        assert float(d["dt"]) > 0
+
+
+class TestPolytropicEOS:
+    def test_values(self):
+        rho = jnp.array([1e6, 2e6])
+        p, c = polytropic_eos(rho)
+        from sphexa_tpu.sph.eos import GAMMA_POL, KPOL_NS
+
+        assert float(p[0]) == pytest.approx(KPOL_NS * 1e18, rel=1e-5)
+        assert float(p[1]) / float(p[0]) == pytest.approx(8.0, rel=1e-5)
+        assert float(c[0]) == pytest.approx(
+            np.sqrt(GAMMA_POL * KPOL_NS * 1e18 / 1e6), rel=1e-5
+        )
+
+    def test_ideal_gas_u(self):
+        p, c = ideal_gas_eos_u(jnp.array([1.5]), jnp.array([2.0]), 5.0 / 3.0)
+        assert float(p[0]) == pytest.approx(2.0)
+        assert float(c[0]) == pytest.approx(np.sqrt(5.0 / 3.0 * 1.0), rel=1e-6)
