@@ -9,6 +9,7 @@ become XLA-inserted collectives (the halo exchange analog); scalar
 reductions (dt, box, energies) become psum/pmin over ICI.
 """
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -63,6 +64,12 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std)
     tree arrays stay replicated across the mesh, matching the reference's
     replicated global octree (assignment.hpp:51-53).
     """
+    # GSPMD has no auto-partitioning rule for Mosaic (pallas) custom calls;
+    # the sharded step therefore always runs the XLA pair path — the pallas
+    # engine is the single-chip fast path until it gains a shard_map wrapper
+    if cfg.backend == "pallas":
+        cfg = dataclasses.replace(cfg, backend="xla")
+
     pspec = NamedSharding(mesh, P("p"))
 
     def stepper(s, b, gtree=None):

@@ -64,6 +64,9 @@ class PropagatorConfig:
     # field-consuming observables read them, avoiding a second full
     # density/EOS pass per step); arrays are in the post-step state order
     keep_fields: bool = False
+    # 'pallas': fused search+op TPU kernels for the std pipeline
+    # (sph/pallas_pairs.py); 'xla': portable gather-based path
+    backend: str = "xla"
 
 
 def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
@@ -140,6 +143,9 @@ def _integrate_and_finish(
         "nc_max": jnp.max(nc) + 1,
         "occupancy": occ,
         "rho_max": jnp.max(rho),
+        # computed in-step so the host never launches a separate reduction
+        # (device->host round trips are expensive over remote links)
+        "h_max": jnp.max(new_h),
     }
     if keep_accels:
         diagnostics.update({"ax": ax, "ay": ay, "az": az})
@@ -166,17 +172,39 @@ def _std_forces(
     state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 
-    nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
+    if cfg.backend == "pallas":
+        # fused search+op TPU kernels: one shared cell-range prologue,
+        # neighbor lists never materialize (sph/pallas_pairs.py)
+        from sphexa_tpu.sph import pallas_pairs as pp
 
-    rho = hydro_std.compute_density(x, y, z, h, m, nidx, nmask, box, const, cfg.block)
-    p, c = hydro_std.compute_eos_std(state.temp, rho, const)
-    c11, c12, c13, c22, c23, c33 = hydro_std.compute_iad(
-        x, y, z, h, m / rho, nidx, nmask, box, const, cfg.block
-    )
-    ax, ay, az, du, dt_courant = hydro_std.compute_momentum_energy_std(
-        x, y, z, state.vx, state.vy, state.vz, h, m, rho, p, c,
-        c11, c12, c13, c22, c23, c33, nidx, nmask, box, const, cfg.block,
-    )
+        ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
+        occ = ranges[2]
+        rho, nc, _ = pp.pallas_density(
+            x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges
+        )
+        p, c = hydro_std.compute_eos_std(state.temp, rho, const)
+        (c11, c12, c13, c22, c23, c33), _ = pp.pallas_iad(
+            x, y, z, h, m / rho, keys, box, const, cfg.nbr, ranges=ranges
+        )
+        ax, ay, az, du, dt_courant, _ = pp.pallas_momentum_energy_std(
+            x, y, z, state.vx, state.vy, state.vz, h, m, rho, p, c,
+            c11, c12, c13, c22, c23, c33, keys, box, const, cfg.nbr,
+            ranges=ranges,
+        )
+    else:
+        nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
+
+        rho = hydro_std.compute_density(
+            x, y, z, h, m, nidx, nmask, box, const, cfg.block
+        )
+        p, c = hydro_std.compute_eos_std(state.temp, rho, const)
+        c11, c12, c13, c22, c23, c33 = hydro_std.compute_iad(
+            x, y, z, h, m / rho, nidx, nmask, box, const, cfg.block
+        )
+        ax, ay, az, du, dt_courant = hydro_std.compute_momentum_energy_std(
+            x, y, z, state.vx, state.vy, state.vz, h, m, rho, p, c,
+            c11, c12, c13, c22, c23, c33, nidx, nmask, box, const, cfg.block,
+        )
 
     extra_dts, gdiag = (), None
     if cfg.gravity is not None:

@@ -11,6 +11,7 @@ import dataclasses
 from typing import Callable, Dict, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from sphexa_tpu.gravity.traversal import GravityConfig, estimate_gravity_caps
@@ -19,6 +20,7 @@ from sphexa_tpu.neighbors.cell_list import (
     NeighborConfig,
     choose_grid_level,
     estimate_cell_cap,
+    estimate_group_window,
 )
 from sphexa_tpu.propagator import (
     PropagatorConfig,
@@ -52,20 +54,40 @@ def make_propagator_config(
     av_clean: bool = False,
     keep_accels: bool = False,
     keep_fields: bool = False,
+    backend: str = "auto",
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
     the driver entry points)."""
+    if backend == "auto":
+        # fused pallas kernels on TPU, portable gather path elsewhere
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
     h_max = float(jnp.max(state.h))
     level = choose_grid_level(np.asarray(box.lengths), h_max)
+    # group-window search covers the 2h radius at ANY level, so the level
+    # is free to target cell occupancy instead: ~128+ particles per cell
+    # keeps the per-cell overhead (DMA issue latency, range lookups)
+    # amortized — deep grids explode the window cell count
+    level_occ = max(1, round(np.log2(max(state.n / 128.0, 1.0)) / 3.0))
+    level = min(level, level_occ)
     keys = np.asarray(compute_sfc_keys(state.x, state.y, state.z, box, curve=curve))
     cap = max(estimate_cell_cap(keys, level), min_cap)
+    # window sizing needs SFC-sorted coordinates (group = consecutive range);
+    # the group size must match the pallas engine's GROUP
+    order = np.argsort(keys)
+    group = 128
+    window = estimate_group_window(
+        np.asarray(state.x)[order], np.asarray(state.y)[order],
+        np.asarray(state.z)[order], state.h, np.asarray(box.lengths), level,
+        group=group,
+    )
     nbr = NeighborConfig(
-        level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block, curve=curve
+        level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block,
+        curve=curve, group=group, window=window,
     )
     return PropagatorConfig(
         const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean,
-        keep_accels=keep_accels, keep_fields=keep_fields,
+        keep_accels=keep_accels, keep_fields=keep_fields, backend=backend,
     )
 
 
@@ -88,6 +110,7 @@ class Simulation:
         grav_bucket: int = 64,
         keep_accels: bool = False,
         keep_fields: bool = False,
+        backend: str = "auto",
         turb_cfg=None,
         turb_state=None,
         turb_settings: Optional[Dict] = None,
@@ -103,6 +126,7 @@ class Simulation:
         self.av_clean = av_clean
         self.keep_accels = keep_accels
         self.keep_fields = keep_fields
+        self.backend = backend
         self.ngmax = ngmax or const.ngmax
         self.theta = theta
         self.grav_bucket = grav_bucket
@@ -171,7 +195,7 @@ class Simulation:
             self.state, self.box, self.const,
             ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
             av_clean=self.av_clean, keep_accels=self.keep_accels,
-            keep_fields=self.keep_fields,
+            keep_fields=self.keep_fields, backend=self.backend,
         )
         if self.gravity_on:
             self._configure_gravity(grav_margin)
@@ -224,7 +248,8 @@ class Simulation:
             return False
         if self.prop_name == "nbody":
             return True
-        h_max = float(jnp.max(self.state.h))
+        # h_max is part of the step diagnostics (one batched transfer)
+        h_max = float(diagnostics["h_max"])
         cell_edge = float(np.min(np.asarray(self.box.lengths))) / (1 << nbr.level)
         return 2.0 * h_max <= cell_edge
 
@@ -252,6 +277,14 @@ class Simulation:
                 new_state, new_box, diagnostics = step_fn(
                     self.state, self.box, self._cfg, self._gtree
                 )
+            # ONE batched device->host transfer for all scalar diagnostics
+            # (separate float()/int() conversions each pay a full round
+            # trip, which dominates on remote-attached TPUs)
+            scalars = {
+                k: v for k, v in diagnostics.items() if getattr(v, "ndim", 0) == 0
+            }
+            fetched = jax.device_get(scalars)
+            diagnostics = {**diagnostics, **fetched}
             nbr_over = int(diagnostics["occupancy"]) > self._cfg.nbr.cap
             grav_over = self._gravity_overflowed(diagnostics)
             if not nbr_over and not grav_over:

@@ -1,0 +1,566 @@
+"""Pallas TPU engine for SPH pair interactions: stream candidate cells
+through VMEM per target group.
+
+TPU-native re-design of the hot j-loops following the reference's GPU
+strategy (cstone/traversal/find_neighbors.cuh: 64-particle warp targets,
+neighbors found on the fly inside each kernel, no stored lists) mapped to
+the TPU memory system:
+
+- targets are groups of G = 128 SFC-consecutive particles (one VMEM block);
+- the group's candidate set is the static ``window^3`` block of grid cells
+  covering its search extent; every cell's particles are CONTIGUOUS in the
+  SFC-sorted arrays, so each cell is ONE dynamic-slice DMA from HBM into a
+  VMEM ring buffer — no XLA gathers anywhere;
+- the pair physics runs cell-by-cell on (G, cap) tiles on the VPU while
+  the next cell's DMA is in flight (double buffering);
+- each op instantiates the shared engine with its own per-pair math and
+  accumulators, fusing neighbor search INTO the op (the reference GPU
+  does exactly this, SURVEY.md §2 'neighbors recomputed on the fly').
+
+The XLA gather-based path (neighbors/cell_list.py + the ops' j-loops)
+remains the portable fallback; this engine is used on TPU where the
+gather rate, not FLOPs, limits throughput.
+"""
+
+import functools
+from typing import Any, Callable, List, NamedTuple, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
+from sphexa_tpu.neighbors.cell_list import NeighborConfig, _window_offsets
+from sphexa_tpu.sfc.box import Box
+from sphexa_tpu.sfc.hilbert import hilbert_encode
+from sphexa_tpu.sfc.morton import morton_encode
+
+GROUP = 128  # targets per group: one f32 lane row
+
+
+class PairGeom(NamedTuple):
+    """Per-(target, candidate) geometry handed to the pair body."""
+
+    rx: jax.Array     # (G, cap) x_i - x_j, minimum image
+    ry: jax.Array
+    rz: jax.Array
+    d2: jax.Array     # squared distance
+    mask: jax.Array   # valid pair: in-range candidate, within 2h_i, not self
+
+
+def group_cell_ranges(x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig):
+    """(starts, lens, occupancy) of every group's window cells.
+
+    Vectorized over all groups (the jax-side prologue both the engine and
+    find_neighbors share conceptually); starts index the SFC-sorted
+    arrays, lens <= cap. occupancy encodes the cap AND window guards like
+    find_neighbors.
+    """
+    n = x.shape[0]
+    level = cfg.level
+    shift = KEY_DTYPE(3 * (KEY_BITS - level))
+    ncell = 1 << level
+    encode = hilbert_encode if cfg.curve == "hilbert" else morton_encode
+    edge = box.lengths / ncell
+    periodic = box.periodic_mask
+
+    g = GROUP
+    num_groups = -(-n // g)
+    pad = num_groups * g - n
+    gather_pad = lambda a: jnp.concatenate([a, jnp.broadcast_to(a[-1:], (pad,))]) if pad else a
+    xg = gather_pad(x).reshape(num_groups, g)
+    yg = gather_pad(y).reshape(num_groups, g)
+    zg = gather_pad(z).reshape(num_groups, g)
+    hg = gather_pad(h).reshape(num_groups, g)
+
+    lo = jnp.stack([xg.min(1), yg.min(1), zg.min(1)], axis=1)  # (NG, 3)
+    hi = jnp.stack([xg.max(1), yg.max(1), zg.max(1)], axis=1)
+    radius = 2.0 * hg.max(1)  # (NG,)
+    box_lo = jnp.stack([box.lo[0], box.lo[1], box.lo[2]])
+    base = jnp.floor((lo - radius[:, None] - box_lo) / edge).astype(jnp.int32)
+    need = jnp.floor((hi + radius[:, None] - box_lo) / edge).astype(jnp.int32)
+    # open dims: cells outside [0, ncell) don't exist — slide the window
+    # inside the grid (never loses coverage); a window spanning the whole
+    # grid always covers
+    base = jnp.where(
+        periodic[None, :], base,
+        jnp.clip(base, 0, max(0, ncell - cfg.window)),
+    )
+    need_eff = jnp.where(periodic[None, :], need, jnp.minimum(need, ncell - 1))
+    window_ok = jnp.all((need_eff - base + 1 <= cfg.window) | (cfg.window >= ncell))
+
+    offsets = jnp.asarray(_window_offsets(cfg.window))  # (W3, 3)
+    cells = base[:, None, :] + offsets[None, :, :]  # (NG, W3, 3)
+    wrapped = jnp.mod(cells, ncell)
+    in_range = (cells >= 0) & (cells < ncell)
+    unique = offsets[None, :, :] < ncell
+    cell_ok = jnp.all(
+        jnp.where(periodic[None, None, :], unique, in_range), axis=-1
+    )  # (NG, W3)
+    cells = jnp.where(
+        periodic[None, None, :], wrapped, jnp.clip(cells, 0, ncell - 1)
+    )
+
+    ckey = encode(
+        cells[..., 0].astype(KEY_DTYPE),
+        cells[..., 1].astype(KEY_DTYPE),
+        cells[..., 2].astype(KEY_DTYPE),
+        bits=level,
+    )
+    start = jnp.searchsorted(sorted_keys, ckey << shift).astype(jnp.int32)
+    end = jnp.searchsorted(sorted_keys, (ckey + KEY_DTYPE(1)) << shift).astype(
+        jnp.int32
+    )
+    raw_len = end - start
+    occupancy = jnp.where(window_ok, jnp.max(raw_len), jnp.int32(cfg.cap + 1))
+    lens = jnp.where(cell_ok, jnp.minimum(raw_len, cfg.cap), 0)
+    return start, lens, occupancy
+
+
+def _round_up(v: int, q: int) -> int:
+    return -(-v // q) * q
+
+
+def group_pair_engine(
+    pair_body: Callable,
+    finalize: Callable,
+    num_i: int,
+    num_j: int,
+    num_acc: int,
+    cfg: NeighborConfig,
+    interpret: bool = False,
+):
+    """Build a pallas_call for one SPH pair op.
+
+    - ``pair_body(geom, i_fields, j_fields, accs) -> accs``: per-cell pair
+      math on (G, cap) tiles; i_fields are (G, 1) columns, j_fields are
+      (1, cap) rows; accs is a tuple of (G, 1) f32 accumulators.
+    - ``finalize(i_fields, accs, nc) -> outs``: per-target epilogue; outs
+      is a tuple of (G,) arrays (f32), one per output.
+    - ``num_i``/``num_j``: how many target/candidate fields follow
+      (x, y, z, h are always fields 0-3 on both sides).
+    - returns fn(starts, lens, boxl, i_fields(NG,G) x num_i,
+      j_fields(n_pad,) x num_j) -> (outs (NG, G) x num_out, nc (NG, G)).
+    """
+    w3 = cfg.window**3
+    cap = cfg.cap
+    # each cell's range [s, s+len) is covered by an 8-row-aligned DMA
+    # window: row_s = s // 128, span slots [0, SPAN) with the valid range at
+    # offset s % 128 (Mosaic requires 8-row-aligned transfer shapes)
+    span = _round_up(128 + cap, 128)
+    buf_rows = max(8, _round_up(span, 1024) // 128)
+
+    def kernel(*refs):
+        starts, lens, boxl = refs[0], refs[1], refs[2]
+        i_refs = refs[3 : 3 + num_i]
+        j_refs = refs[3 + num_i : 3 + num_i + num_j]
+        out_refs = refs[3 + num_i + num_j : -2 - num_j]
+        nc_ref = refs[-2 - num_j]
+        bufs = refs[-1 - num_j : -1]
+        sems = refs[-1]
+
+        gi = pl.program_id(0)
+        G = GROUP
+
+        def dma(w, slot):
+            row_s = starts[0, 0, w] // 128
+            return [
+                pltpu.make_async_copy(
+                    j_refs[f].at[pl.ds(row_s, buf_rows), :],
+                    bufs[f].at[slot],
+                    sems.at[slot, f],
+                )
+                for f in range(num_j)
+            ]
+
+        for d in dma(0, 0):
+            d.start()
+
+        i_fields = [r[0, 0][:, None] for r in i_refs]  # (G, 1) each
+        xi, yi, zi, hi = i_fields[:4]
+        lx, ly, lz = boxl[0, 0, 0], boxl[0, 0, 1], boxl[0, 0, 2]
+        tgt_idx = gi * G + jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+        span_iota = jax.lax.broadcasted_iota(jnp.int32, (1, span), 1)
+
+        def body(w, carry):
+            accs, nc_acc = carry
+            slot = w % 2
+
+            @pl.when(w + 1 < w3)
+            def _():
+                for d in dma(w + 1, (w + 1) % 2):
+                    d.start()
+
+            for d in dma(w, slot):
+                d.wait()
+
+            s = starts[0, 0, w]
+            ln = lens[0, 0, w]
+            off = s - (s // 128) * 128
+            j_fields = [
+                bufs[f][slot].reshape(1, buf_rows * 128)[:, :span]
+                for f in range(num_j)
+            ]  # (1, span)
+            cx, cy, cz = j_fields[0], j_fields[1], j_fields[2]
+
+            rx = xi - cx
+            ry = yi - cy
+            rz = zi - cz
+            rx = rx - lx * jnp.round(rx / lx)
+            ry = ry - ly * jnp.round(ry / ly)
+            rz = rz - lz * jnp.round(rz / lz)
+            d2 = rx * rx + ry * ry + rz * rz
+
+            cand_idx = (s - off) + span_iota
+            mask = (
+                (span_iota >= off)
+                & (span_iota < off + ln)
+                & (d2 < 4.0 * hi * hi)
+                & (cand_idx != tgt_idx)
+            )
+            geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
+            accs = pair_body(geom, i_fields, j_fields, accs)
+            nc_acc = nc_acc + jnp.sum(mask, axis=1, keepdims=True)
+            return accs, nc_acc
+
+        acc0 = tuple(jnp.zeros((G, 1), jnp.float32) for _ in range(num_acc))
+        nc0 = jnp.zeros((G, 1), jnp.int32)
+        accs, nc_acc = jax.lax.fori_loop(0, w3, body, (acc0, nc0))
+
+        outs = finalize(i_fields, accs, nc_acc)
+        for r, o in zip(out_refs, outs):
+            r[0, 0] = o.reshape(GROUP)
+        nc_ref[0, 0] = nc_acc.reshape(GROUP)
+
+    def call(starts, lens, boxl, i_fields: Sequence, j_fields: Sequence):
+        num_groups = starts.shape[0]
+        starts = starts.reshape(num_groups, 1, w3)
+        lens = lens.reshape(num_groups, 1, w3)
+        boxl = boxl.reshape(1, 1, 3)
+        i_fields = [a.reshape(num_groups, 1, GROUP) for a in i_fields]
+        num_out_arrays = len(
+            finalize(
+                [jnp.zeros((GROUP, 1))] * num_i,
+                tuple(jnp.zeros((GROUP, 1)) for _ in range(num_acc)),
+                jnp.zeros((GROUP, 1), jnp.int32),
+            )
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(num_groups,),
+            in_specs=[
+                pl.BlockSpec((1, 1, w3), lambda g: (g, 0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, w3), lambda g: (g, 0, 0), memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, 3), lambda g: (0, 0, 0), memory_space=pltpu.SMEM),
+            ]
+            + [
+                pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))
+                for _ in range(num_i)
+            ]
+            + [pl.BlockSpec(memory_space=pl.ANY) for _ in range(num_j)],
+            out_specs=[
+                pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))
+                for _ in range(num_out_arrays)
+            ]
+            + [pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))],
+            scratch_shapes=[
+                pltpu.VMEM((2, buf_rows, 128), jnp.float32) for _ in range(num_j)
+            ]
+            + [pltpu.SemaphoreType.DMA((2, num_j))],
+        )
+        out_shape = [
+            jax.ShapeDtypeStruct((num_groups, 1, GROUP), jnp.float32)
+            for _ in range(num_out_arrays)
+        ] + [jax.ShapeDtypeStruct((num_groups, 1, GROUP), jnp.int32)]
+        outs = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(starts, lens, boxl, *i_fields, *j_fields)
+        return outs
+
+    return call
+
+
+def _prep(x, y, z, h, extra_i, extra_j, box: Box, cfg: NeighborConfig):
+    """Common jax-side prologue: padded/blocked field layouts.
+
+    j-side fields are reshaped (rows, 128) so the kernel can DMA 8-row
+    aligned windows; the tail is padded by one full window so a range
+    starting at the last particle still reads in-bounds garbage (masked).
+    """
+    n = x.shape[0]
+    span = _round_up(128 + cfg.cap, 128)
+    pad_tail = max(8, _round_up(span, 1024) // 128) * 128
+    num_groups = -(-n // GROUP)
+    pad_i = num_groups * GROUP - n
+
+    def block_i(a):
+        a = jnp.concatenate([a, jnp.broadcast_to(a[-1:], (pad_i,))]) if pad_i else a
+        return a.reshape(num_groups, GROUP)
+
+    def pad_j(a):
+        rows = _round_up(n + pad_tail, 128) // 128
+        out = jnp.zeros(rows * 128, a.dtype)
+        return out.at[:n].set(a).reshape(rows, 128)
+
+    # open dims use an effectively-infinite period so the fold is a no-op
+    big = jnp.float32(1e30)
+    boxl = jnp.where(box.periodic_mask, box.lengths, big).astype(jnp.float32)
+    boxl = boxl.reshape(1, 3)
+
+    i_fields = [block_i(a) for a in (x, y, z, h, *extra_i)]
+    j_fields = [pad_j(a) for a in (x, y, z, *extra_j)]
+    return i_fields, j_fields, boxl, num_groups
+
+
+def pallas_density(
+    x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
+    ranges=None, interpret: bool = False,
+):
+    """rho_i = K h_i^-3 (m_i + sum_j m_j W(|r_ij|/h_i)) + neighbor counts.
+
+    Pallas instantiation of hydro_std.compute_density (density.hpp:41) with
+    the search fused in. Returns (rho (n,), nc (n,), occupancy).
+    """
+    n = x.shape[0]
+    sinc_n = _int_sinc_index(const)
+    K = float(const.K)
+
+    starts, lens, occ = (
+        ranges
+        if ranges is not None
+        else group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
+    )
+
+    def pair_body(geom, i_fields, j_fields, accs):
+        (rho_sum,) = accs
+        hi = i_fields[3]
+        mj = j_fields[3]
+        v = jnp.sqrt(geom.d2) / hi
+        pv = (0.5 * np.pi) * v
+        sinc = jnp.where(v > 0.0, jnp.sin(pv) / jnp.where(v > 0.0, pv, 1.0), 1.0)
+        w = sinc
+        for _ in range(sinc_n - 1):
+            w = w * sinc
+        rho_sum = rho_sum + jnp.sum(
+            jnp.where(geom.mask, mj * w, 0.0), axis=1, keepdims=True
+        )
+        return (rho_sum,)
+
+    def finalize(i_fields, accs, nc):
+        hi = i_fields[3]
+        mi = i_fields[4]
+        (rho_sum,) = accs
+        rho = K * (mi + rho_sum) / (hi * hi * hi)
+        return (rho,)
+
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=5, num_j=4, num_acc=1, cfg=cfg,
+        interpret=interpret,
+    )
+    i_fields, j_fields, boxl, _ = _prep(x, y, z, h, (m,), (m,), box, cfg)
+    rho, nc = engine(starts, lens, boxl, i_fields, j_fields)
+    return rho.reshape(-1)[:n], nc.reshape(-1)[:n], occ
+
+
+def _int_sinc_index(const) -> int:
+    """The pallas kernels unroll the sinc power; fractional indices must
+    use the XLA backend."""
+    n = int(const.sinc_index)
+    if const.sinc_index != n:
+        raise ValueError(
+            f"pallas backend supports integer sinc indices only "
+            f"(got {const.sinc_index}); use backend='xla'"
+        )
+    return n
+
+
+def _sinc_w(d2, hi, sinc_n: int):
+    """sinc^n kernel on (G, span) tiles from squared distance and h_i."""
+    v = jnp.sqrt(d2) / hi
+    pv = (0.5 * np.pi) * v
+    sinc = jnp.where(v > 0.0, jnp.sin(pv) / jnp.where(v > 0.0, pv, 1.0), 1.0)
+    w = sinc
+    for _ in range(sinc_n - 1):
+        w = w * sinc
+    return w
+
+
+def pallas_iad(
+    x, y, z, h, vol, sorted_keys, box: Box, const, cfg: NeighborConfig,
+    ranges=None, interpret: bool = False,
+):
+    """IAD tensor components (hydro_std.compute_iad, iad_kern.hpp) with the
+    neighbor search fused in. ``vol`` is the per-particle volume estimate
+    (m/rho std, xm/kx VE). Returns (c11..c33, occupancy)."""
+    n = x.shape[0]
+    sinc_n = _int_sinc_index(const)
+    K = float(const.K)
+
+    starts, lens, occ = (
+        ranges
+        if ranges is not None
+        else group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
+    )
+
+    def pair_body(geom, i_fields, j_fields, accs):
+        hi = i_fields[3]
+        vj = j_fields[3]
+        w = _sinc_w(geom.d2, hi, sinc_n)
+        vw = jnp.where(geom.mask, vj * w, 0.0)
+        terms = (
+            geom.rx * geom.rx, geom.rx * geom.ry, geom.rx * geom.rz,
+            geom.ry * geom.ry, geom.ry * geom.rz, geom.rz * geom.rz,
+        )
+        return tuple(
+            acc + jnp.sum(t * vw, axis=1, keepdims=True)
+            for acc, t in zip(accs, terms)
+        )
+
+    def finalize(i_fields, accs, nc):
+        hi = i_fields[3]
+        t11, t12, t13, t22, t23, t33 = accs
+        # exponent renormalization (iad_kern.hpp ilogb/ldexp trick) via
+        # exp2/log2 — exact because the factor cancels in adj/det
+        exp_of = lambda v: jnp.where(
+            v != 0.0, jnp.floor(jnp.log2(jnp.abs(v) + 1e-45)), 0.0
+        )
+        esum = (exp_of(t11) + exp_of(t12) + exp_of(t13)
+                + exp_of(t22) + exp_of(t23) + exp_of(t33))
+        norm = jnp.exp2(-jnp.floor(esum / 6.0))
+        t11, t12, t13 = t11 * norm, t12 * norm, t13 * norm
+        t22, t23, t33 = t22 * norm, t23 * norm, t33 * norm
+        det = (t11 * t22 * t33 + 2.0 * t12 * t23 * t13
+               - t11 * t23 * t23 - t22 * t13 * t13 - t33 * t12 * t12)
+        factor = norm * (hi * hi * hi) / (det * K)
+        return (
+            (t22 * t33 - t23 * t23) * factor,
+            (t13 * t23 - t33 * t12) * factor,
+            (t12 * t23 - t22 * t13) * factor,
+            (t11 * t33 - t13 * t13) * factor,
+            (t13 * t12 - t11 * t23) * factor,
+            (t11 * t22 - t12 * t12) * factor,
+        )
+
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=4, num_j=4, num_acc=6, cfg=cfg,
+        interpret=interpret,
+    )
+    i_fields, j_fields, boxl, _ = _prep(x, y, z, h, (), (vol,), box, cfg)
+    *cs, _nc = engine(starts, lens, boxl, i_fields, j_fields)
+    return tuple(c.reshape(-1)[:n] for c in cs), occ
+
+
+def pallas_momentum_energy_std(
+    x, y, z, vx, vy, vz, h, m, rho, p, c,
+    c11, c12, c13, c22, c23, c33,
+    sorted_keys, box: Box, const, cfg: NeighborConfig,
+    ranges=None, interpret: bool = False,
+):
+    """Pressure-gradient accelerations + energy rate + Courant dt
+    (hydro_std.compute_momentum_energy_std, momentum_energy_kern.hpp:12-134)
+    with the neighbor search fused in. Returns (ax, ay, az, du, min_dt, occ).
+    """
+    n = x.shape[0]
+    sinc_n = _int_sinc_index(const)
+    K = float(const.K)
+    k_cour = float(const.k_cour)
+
+    starts, lens, occ = (
+        ranges
+        if ranges is not None
+        else group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
+    )
+
+    def pair_body(geom, i_fields, j_fields, accs):
+        momx, momy, momz, energy, maxvs = accs
+        (xi, yi, zi, hi, vxi, vyi, vzi, ci, rhoi, pi, mi,
+         c11i, c12i, c13i, c22i, c23i, c33i) = i_fields
+        (cx, cy, cz, hj, vxj, vyj, vzj, cj, rhoj, pj, mj,
+         c11j, c12j, c13j, c22j, c23j, c33j) = j_fields
+
+        dist = jnp.sqrt(jnp.where(geom.mask, geom.d2, 1.0))
+        dist = jnp.where(geom.mask, dist, 1.0)
+        w_i = _sinc_w(geom.d2, hi, sinc_n) / (hi * hi * hi)
+        v2 = jnp.clip(dist / hj, 0.0, 2.0)
+        pv = (0.5 * np.pi) * v2
+        sincj = jnp.where(v2 > 0.0, jnp.sin(pv) / jnp.where(v2 > 0.0, pv, 1.0), 1.0)
+        w_j = sincj
+        for _ in range(sinc_n - 1):
+            w_j = w_j * sincj
+        w_j = w_j / (hj * hj * hj)
+
+        vx_ij = vxi - vxj
+        vy_ij = vyi - vyj
+        vz_ij = vzi - vzj
+        rv = geom.rx * vx_ij + geom.ry * vy_ij + geom.rz * vz_ij
+        w_ij = rv / dist
+
+        # Monaghan constant-alpha AV, halved per pair (kernels.hpp:60-84)
+        v_signal = 0.5 * (ci + cj) - 2.0 * w_ij
+        visc = 0.5 * jnp.where(w_ij < 0.0, -v_signal * w_ij, 0.0)
+
+        vijsignal = ci + cj - 3.0 * w_ij
+        maxvs = jnp.maximum(
+            maxvs, jnp.max(jnp.where(geom.mask, vijsignal, 0.0), axis=1,
+                           keepdims=True)
+        )
+
+        tA1_i = c11i * geom.rx + c12i * geom.ry + c13i * geom.rz
+        tA2_i = c12i * geom.rx + c22i * geom.ry + c23i * geom.rz
+        tA3_i = c13i * geom.rx + c23i * geom.ry + c33i * geom.rz
+        tA1_j = c11j * geom.rx + c12j * geom.ry + c13j * geom.rz
+        tA2_j = c12j * geom.rx + c22j * geom.ry + c23j * geom.rz
+        tA3_j = c13j * geom.rx + c23j * geom.ry + c33j * geom.rz
+
+        mj_pro_i = mj * pi / (rhoi * rhoi)
+        mj_roj_wj = mj / rhoj * w_j
+        mi_roi = mi / rhoi
+
+        a = w_i * (mj_pro_i + visc * mi_roi)
+        b = mj_roj_wj * (pj / rhoj + visc)
+        mm = geom.mask
+        momx = momx + jnp.sum(jnp.where(mm, a * tA1_i + b * tA1_j, 0.0), 1, keepdims=True)
+        momy = momy + jnp.sum(jnp.where(mm, a * tA2_i + b * tA2_j, 0.0), 1, keepdims=True)
+        momz = momz + jnp.sum(jnp.where(mm, a * tA3_i + b * tA3_j, 0.0), 1, keepdims=True)
+
+        a_e = w_i * (2.0 * mj_pro_i + visc * mi_roi)
+        b_e = visc * mj_roj_wj
+        energy = energy + jnp.sum(
+            jnp.where(
+                mm,
+                vx_ij * (a_e * tA1_i + b_e * tA1_j)
+                + vy_ij * (a_e * tA2_i + b_e * tA2_j)
+                + vz_ij * (a_e * tA3_i + b_e * tA3_j),
+                0.0,
+            ),
+            1, keepdims=True,
+        )
+        return momx, momy, momz, energy, maxvs
+
+    def finalize(i_fields, accs, nc):
+        hi = i_fields[3]
+        ci = i_fields[7]
+        momx, momy, momz, energy, maxvs = accs
+        du = -K * 0.5 * energy
+        v = jnp.where(maxvs > 0.0, maxvs, ci)
+        dt_i = k_cour * hi / v
+        return (K * momx, K * momy, K * momz, du, dt_i)
+
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=17, num_j=17, num_acc=5, cfg=cfg,
+        interpret=interpret,
+    )
+    i_fields, j_fields, boxl, _ = _prep(
+        x, y, z, h,
+        (vx, vy, vz, c, rho, p, m, c11, c12, c13, c22, c23, c33),
+        (h, vx, vy, vz, c, rho, p, m, c11, c12, c13, c22, c23, c33),
+        box, cfg,
+    )
+    ax, ay, az, du, dt_i, _nc = engine(starts, lens, boxl, i_fields, j_fields)
+    f = lambda a: a.reshape(-1)[:n]
+    return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), occ

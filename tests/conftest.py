@@ -8,21 +8,25 @@ the test double.
 
 import os
 
-# Tests are CPU-only. The axon sitecustomize hook pre-imports jax at
-# interpreter boot with JAX_PLATFORMS=axon, so plain env-var assignment here
-# is too late for jax's config — override through jax.config instead.
-# XLA_FLAGS *is* still read lazily at first backend init, so setting it here
-# works as long as no jax op has run yet.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Tests are CPU-only by default. The axon sitecustomize hook pre-imports
+# jax at interpreter boot with JAX_PLATFORMS=axon, so plain env-var
+# assignment here is too late for jax's config — override through
+# jax.config instead. XLA_FLAGS *is* still read lazily at first backend
+# init, so setting it here works as long as no jax op has run yet.
+#
+# SPHEXA_TPU_TESTS=1 keeps the real TPU backend (for the device-equivalence
+# tier, tests/test_pallas_tpu.py).
+if not os.environ.get("SPHEXA_TPU_TESTS"):
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-import jax
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
