@@ -1,0 +1,156 @@
+// Native host-runtime components of sphexa-tpu.
+//
+// Role-equivalent of the host side of the reference's C++ runtime
+// (cstone/sfc/{hilbert,morton,sfc}.hpp key generation and the
+// domain-decomposition occupancy accounting): the (re)configuration path
+// of the Python driver — SFC key generation for a snapshot of particle
+// positions, sort-order computation, per-cell occupancy and group-window
+// sizing — runs on the host, where numpy/jax round-trips are the cost.
+// This translation unit packages those steps as a small C ABI consumed
+// via ctypes (sphexa_tpu/native/__init__.py), with OpenMP parallel loops
+// standing in for the reference's `#pragma omp parallel for` drivers.
+//
+// The Hilbert codec mirrors sphexa_tpu/sfc/hilbert.py (Skilling's
+// public-domain transpose algorithm, AIP Conf. Proc. 707, 2004) exactly,
+// bit for bit — tests/test_native.py asserts equality with the jax codec.
+//
+// Build:  make -C sphexa_tpu/native   (g++ -O3 -fopenmp -shared -fPIC)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr int KEY_BITS = 10;
+
+inline uint32_t spread_bits_3d(uint32_t v) {
+    v &= 0x3FFu;
+    v = (v | (v << 16)) & 0x030000FFu;
+    v = (v | (v << 8)) & 0x0300F00Fu;
+    v = (v | (v << 4)) & 0x030C30C3u;
+    v = (v | (v << 2)) & 0x09249249u;
+    return v;
+}
+
+// Skilling AxesToTranspose, mirroring sphexa_tpu/sfc/hilbert.py
+inline void axes_to_transpose(uint32_t X[3], int bits) {
+    for (uint32_t q = 1u << (bits - 1); q > 1; q >>= 1) {
+        uint32_t p = q - 1;
+        for (int i = 0; i < 3; i++) {
+            if (X[i] & q) {
+                X[0] ^= p;
+            } else {
+                uint32_t t = (X[0] ^ X[i]) & p;
+                X[0] ^= t;
+                X[i] ^= t;
+            }
+        }
+    }
+    X[1] ^= X[0];
+    X[2] ^= X[1];
+    uint32_t t = 0;
+    for (uint32_t q = 1u << (bits - 1); q > 1; q >>= 1) {
+        if (X[2] & q) t ^= q - 1;
+    }
+    X[0] ^= t;
+    X[1] ^= t;
+    X[2] ^= t;
+}
+
+inline uint32_t hilbert_key(uint32_t ix, uint32_t iy, uint32_t iz, int bits) {
+    uint32_t X[3] = {ix, iy, iz};
+    axes_to_transpose(X, bits);
+    return (spread_bits_3d(X[0]) << 2) | (spread_bits_3d(X[1]) << 1) |
+           spread_bits_3d(X[2]);
+}
+
+inline uint32_t morton_key(uint32_t ix, uint32_t iy, uint32_t iz) {
+    return (spread_bits_3d(ix) << 2) | (spread_bits_3d(iy) << 1) |
+           spread_bits_3d(iz);
+}
+
+inline uint32_t to_grid(float v, float lo, float len, int ncell) {
+    float scaled = (v - lo) / len * static_cast<float>(ncell);
+    int g = static_cast<int>(scaled);
+    return static_cast<uint32_t>(std::min(std::max(g, 0), ncell - 1));
+}
+
+}  // namespace
+
+extern "C" {
+
+// keys[i] = SFC key of (x, y, z)[i] in the box [lo, lo+len)^3.
+// curve: 0 = Hilbert, 1 = Morton. Mirrors compute_sfc_keys (sfc/keys.py).
+void sfc_compute_keys(const float* x, const float* y, const float* z,
+                      int64_t n, const float* box_lo, const float* box_len,
+                      int curve, uint32_t* keys) {
+    const int ncell = 1 << KEY_BITS;
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t ix = to_grid(x[i], box_lo[0], box_len[0], ncell);
+        uint32_t iy = to_grid(y[i], box_lo[1], box_len[1], ncell);
+        uint32_t iz = to_grid(z[i], box_lo[2], box_len[2], ncell);
+        keys[i] = curve == 0 ? hilbert_key(ix, iy, iz, KEY_BITS)
+                             : morton_key(ix, iy, iz);
+    }
+}
+
+// Stable argsort of keys (the host-side SfcSorter role,
+// cstone/primitives/gather.hpp:26-165). order must hold n int64 slots.
+void sfc_argsort(const uint32_t* keys, int64_t n, int64_t* order) {
+    for (int64_t i = 0; i < n; i++) order[i] = i;
+    std::stable_sort(order, order + n, [keys](int64_t a, int64_t b) {
+        return keys[a] < keys[b];
+    });
+}
+
+// Max level-`level` cell occupancy of sorted keys (estimate_cell_cap's
+// counting loop, neighbors/cell_list.py).
+int64_t sfc_max_cell_occupancy(const uint32_t* sorted_keys, int64_t n,
+                               int level) {
+    if (n == 0) return 0;
+    const int shift = 3 * (KEY_BITS - level);
+    int64_t best = 1, run = 1;
+    for (int64_t i = 1; i < n; i++) {
+        if ((sorted_keys[i] >> shift) == (sorted_keys[i - 1] >> shift)) {
+            if (++run > best) best = run;
+        } else {
+            run = 1;
+        }
+    }
+    return best;
+}
+
+// Max extent over SFC-consecutive groups of `group` particles, per
+// dimension (the measurement behind estimate_group_window,
+// neighbors/cell_list.py). ext_out: 3 floats.
+void sfc_group_extents(const float* x, const float* y, const float* z,
+                       const int64_t* order, int64_t n, int group,
+                       float* ext_out) {
+    const float* dims[3] = {x, y, z};
+    for (int d = 0; d < 3; d++) {
+        float best = 0.0f;
+        for (int64_t g0 = 0; g0 < n; g0 += group) {
+            int64_t g1 = std::min(g0 + static_cast<int64_t>(group), n);
+            float lo = dims[d][order[g0]], hi = lo;
+            for (int64_t i = g0 + 1; i < g1; i++) {
+                float v = dims[d][order[i]];
+                lo = std::min(lo, v);
+                hi = std::max(hi, v);
+            }
+            best = std::max(best, hi - lo);
+        }
+        ext_out[d] = best;
+    }
+}
+
+int sfc_runtime_abi_version() { return 1; }
+
+}  // extern "C"

@@ -76,19 +76,28 @@ def choose_grid_level(box_lengths, h_max: float) -> int:
     return max(1, min(KEY_BITS, level))
 
 
-def estimate_cell_cap(keys, level: int, margin: float = 1.3, quantum: int = 8) -> int:
-    """Max level-``level`` cell occupancy of ``keys``, padded with slack.
+def pad_cap(occ: int, margin: float = 1.3, quantum: int = 8) -> int:
+    """Pad an observed max cell occupancy into a static cap: the margin
+    absorbs particle motion between reconfigurations; the quantum rounds up
+    so small occupancy drifts do not change the static cap (and thus do
+    not recompile). SINGLE source of truth for the sizing constants."""
+    return max(quantum, int(np.ceil(occ * margin / quantum) * quantum))
 
-    Host-side helper run at (re)configuration time. The margin absorbs
-    particle motion between reconfigurations; the quantum rounds up so small
-    occupancy drifts do not change the static cap (and thus do not
-    recompile).
-    """
+
+def window_cells(ext: float, radius: float, edge: float, ncell: int,
+                 margin_cells: int = 1) -> int:
+    """Cells needed along one dimension to cover a group extent + search
+    radius, clamped to the grid (whole-grid coverage always suffices)."""
+    return min(int(np.ceil((ext + radius) / edge)) + 1 + margin_cells, ncell)
+
+
+def estimate_cell_cap(keys, level: int, margin: float = 1.3, quantum: int = 8) -> int:
+    """Max level-``level`` cell occupancy of ``keys``, padded with slack
+    (host-side helper run at (re)configuration time)."""
     shift = 3 * (KEY_BITS - level)
     cells = np.asarray(keys, dtype=np.uint64) >> np.uint64(shift)
     occ = int(np.bincount(cells.astype(np.int64)).max()) if len(cells) else 1
-    padded = int(np.ceil(occ * margin / quantum) * quantum)
-    return max(quantum, padded)
+    return pad_cap(occ, margin, quantum)
 
 
 def estimate_group_window(
@@ -115,8 +124,7 @@ def estimate_group_window(
             a = np.concatenate([a, np.repeat(a[-1], pad)])
         g = a.reshape(ng, group)
         ext = float((g.max(axis=1) - g.min(axis=1)).max())
-        need_d = int(np.ceil((ext + radius) / edge)) + 1 + margin_cells
-        need = max(need, min(need_d, ncell))
+        need = max(need, window_cells(ext, radius, edge, ncell, margin_cells))
     return need
 
 

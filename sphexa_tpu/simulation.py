@@ -19,8 +19,6 @@ from sphexa_tpu.gravity.tree import build_gravity_tree
 from sphexa_tpu.neighbors.cell_list import (
     NeighborConfig,
     choose_grid_level,
-    estimate_cell_cap,
-    estimate_group_window,
 )
 from sphexa_tpu.propagator import (
     PropagatorConfig,
@@ -31,7 +29,6 @@ from sphexa_tpu.propagator import (
     step_turb_ve,
 )
 from sphexa_tpu.sfc.box import BoundaryType, Box
-from sphexa_tpu.sfc.keys import compute_sfc_keys
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
 
 _PROPAGATORS: Dict[str, Callable] = {
@@ -62,25 +59,37 @@ def make_propagator_config(
     if backend == "auto":
         # fused pallas kernels on TPU, portable gather path elsewhere
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
-    h_max = float(jnp.max(state.h))
-    level = choose_grid_level(np.asarray(box.lengths), h_max)
+    h = np.asarray(state.h)
+    h_max = float(h.max())
+    lengths = np.asarray(box.lengths)
+    level = choose_grid_level(lengths, h_max)
     # group-window search covers the 2h radius at ANY level, so the level
     # is free to target cell occupancy instead: ~128+ particles per cell
     # keeps the per-cell overhead (DMA issue latency, range lookups)
     # amortized — deep grids explode the window cell count
     level_occ = max(1, round(np.log2(max(state.n / 128.0, 1.0)) / 3.0))
     level = min(level, level_occ)
-    keys = np.asarray(compute_sfc_keys(state.x, state.y, state.z, box, curve=curve))
-    cap = max(estimate_cell_cap(keys, level), min_cap)
-    # window sizing needs SFC-sorted coordinates (group = consecutive range);
-    # the group size must match the pallas engine's GROUP
-    order = np.argsort(keys)
-    group = 128
-    window = estimate_group_window(
-        np.asarray(state.x)[order], np.asarray(state.y)[order],
-        np.asarray(state.z)[order], state.h, np.asarray(box.lengths), level,
-        group=group,
-    )
+
+    # host-side sizing pass: one device->host transfer of the coordinates,
+    # then the native C++ runtime (sphexa_tpu/native) does keygen, sort and
+    # occupancy/window accounting (numpy/jax fallback inside)
+    from sphexa_tpu import native
+
+    xa = np.asarray(state.x)
+    ya = np.asarray(state.y)
+    za = np.asarray(state.z)
+    keys = native.compute_keys(xa, ya, za, np.asarray(box.lo), lengths, curve)
+    order = native.argsort_keys(keys)
+    from sphexa_tpu.neighbors.cell_list import pad_cap, window_cells
+
+    cap = max(pad_cap(native.max_cell_occupancy(keys[order], level)), min_cap)
+    group = 128  # must match the pallas engine's GROUP
+    ncell = 1 << level
+    ext = native.group_extents(xa, ya, za, order, group)
+    radius = 4.0 * h_max
+    window = 1
+    for e, edge in zip(ext, lengths / ncell):
+        window = max(window, window_cells(e, radius, float(edge), ncell))
     nbr = NeighborConfig(
         level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block,
         curve=curve, group=group, window=window,
@@ -205,9 +214,14 @@ class Simulation:
         distribution and size the interaction-list caps (the gravity analog
         of re-sizing the neighbor cell grid — host work, reconfiguration
         granularity only)."""
+        from sphexa_tpu import native
+
         s = self.state
-        keys = np.asarray(compute_sfc_keys(s.x, s.y, s.z, self.box, curve=self.curve))
-        order = np.argsort(keys)
+        keys = native.compute_keys(
+            np.asarray(s.x), np.asarray(s.y), np.asarray(s.z),
+            np.asarray(self.box.lo), np.asarray(self.box.lengths), self.curve,
+        )
+        order = native.argsort_keys(keys)
         skeys = jnp.asarray(keys[order])
         xs = jnp.asarray(np.asarray(s.x)[order])
         ys = jnp.asarray(np.asarray(s.y)[order])

@@ -123,6 +123,17 @@ def _round_up(v: int, q: int) -> int:
     return -(-v // q) * q
 
 
+def _dma_geometry(cap: int):
+    """(span, buf_rows): each cell range [s, s+len) is covered by an
+    8-row-aligned DMA window of buf_rows rows; the valid range sits at
+    offset s % 128 within the first ``span`` slots. SINGLE source of truth
+    — the kernel's transfer shape and _prep's tail padding must agree or
+    the DMA reads out of bounds."""
+    span = _round_up(128 + cap, 128)
+    buf_rows = max(8, _round_up(span, 1024) // 128)
+    return span, buf_rows
+
+
 def group_pair_engine(
     pair_body: Callable,
     finalize: Callable,
@@ -145,12 +156,7 @@ def group_pair_engine(
       j_fields(n_pad,) x num_j) -> (outs (NG, G) x num_out, nc (NG, G)).
     """
     w3 = cfg.window**3
-    cap = cfg.cap
-    # each cell's range [s, s+len) is covered by an 8-row-aligned DMA
-    # window: row_s = s // 128, span slots [0, SPAN) with the valid range at
-    # offset s % 128 (Mosaic requires 8-row-aligned transfer shapes)
-    span = _round_up(128 + cap, 128)
-    buf_rows = max(8, _round_up(span, 1024) // 128)
+    span, buf_rows = _dma_geometry(cfg.cap)
 
     def kernel(*refs):
         starts, lens, boxl = refs[0], refs[1], refs[2]
@@ -293,8 +299,8 @@ def _prep(x, y, z, h, extra_i, extra_j, box: Box, cfg: NeighborConfig):
     starting at the last particle still reads in-bounds garbage (masked).
     """
     n = x.shape[0]
-    span = _round_up(128 + cfg.cap, 128)
-    pad_tail = max(8, _round_up(span, 1024) // 128) * 128
+    _, buf_rows = _dma_geometry(cfg.cap)
+    pad_tail = buf_rows * 128
     num_groups = -(-n // GROUP)
     pad_i = num_groups * GROUP - n
 
@@ -340,12 +346,7 @@ def pallas_density(
         (rho_sum,) = accs
         hi = i_fields[3]
         mj = j_fields[3]
-        v = jnp.sqrt(geom.d2) / hi
-        pv = (0.5 * np.pi) * v
-        sinc = jnp.where(v > 0.0, jnp.sin(pv) / jnp.where(v > 0.0, pv, 1.0), 1.0)
-        w = sinc
-        for _ in range(sinc_n - 1):
-            w = w * sinc
+        w = _sinc_w(geom.d2, hi, sinc_n)
         rho_sum = rho_sum + jnp.sum(
             jnp.where(geom.mask, mj * w, 0.0), axis=1, keepdims=True
         )
