@@ -78,8 +78,12 @@ def main(argv=None) -> int:
     from sphexa_tpu.init import CASES
     from sphexa_tpu.init.file_init import looks_like_file, parse_file_spec
 
+    from sphexa_tpu.init import split_case_spec
+
     log = (lambda *a, **k: None) if args.quiet else print
-    case_name = args.init
+    # 'case:settings.json' selects the case with overrides; observables key
+    # on the bare case name
+    case_name, _ = split_case_spec(args.init)
     is_restart = args.init not in CASES and looks_like_file(args.init)
     turb_state, turb_cfg, restart_iteration = None, None, 0
     if is_restart:

@@ -45,24 +45,57 @@ CASES: Dict[str, Callable] = {
 }
 
 
+def split_case_spec(name: str):
+    """'case:settings.json' -> (case, settings_path); otherwise (name, None).
+    SINGLE source of the spec grammar — main.py keys observables/dump
+    metadata on the same parse."""
+    if ":" in name:
+        case, _, settings_path = name.partition(":")
+        if case in CASES:
+            return case, settings_path
+    return name, None
+
+
 def make_initializer(name: str) -> Callable:
     """Look up a test case by reference CLI name, or build a file-restart
-    initializer for 'path[:step]' arguments (init/factory.hpp:43-111)."""
+    initializer for 'path[:step]' arguments (init/factory.hpp:43-111).
+
+    ``case:settings.json`` appends a JSON settings file whose keys override
+    the case defaults (the reference's ``--init sedov:my_settings`` path,
+    factory.hpp:47-48).
+    """
     if name in CASES:
         return CASES[name]
+
+    case, settings_path = split_case_spec(name)
+    if settings_path is not None:
+        import json
+
+        try:
+            with open(settings_path) as f:
+                overrides = json.load(f)
+        except OSError as e:
+            raise ValueError(f"cannot read settings file {settings_path}: {e}")
+        except json.JSONDecodeError as e:
+            raise ValueError(f"invalid JSON in {settings_path}: {e}")
+        if not isinstance(overrides, dict):
+            raise ValueError(f"{settings_path} must hold a JSON object")
+        return functools.partial(CASES[case], overrides=overrides)
+
     from sphexa_tpu.init.file_init import init_from_file, looks_like_file
 
     if looks_like_file(name):
         return functools.partial(init_from_file, name)
     raise ValueError(
         f"unknown test case '{name}' (not a case name in {sorted(CASES)}, "
-        "not an existing snapshot file)"
+        "not 'case:settings.json', not an existing snapshot file)"
     )
 
 
 __all__ = [
     "CASES",
     "make_initializer",
+    "split_case_spec",
     "regular_grid",
     "init_sedov", "sedov_constants",
     "init_noh", "noh_constants",

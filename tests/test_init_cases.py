@@ -35,6 +35,17 @@ class TestFactory:
         with pytest.raises(ValueError):
             make_initializer("nope")
 
+    def test_settings_file_overrides(self, tmp_path):
+        """'case:settings.json' applies JSON overrides to the case defaults
+        (the reference's --init sedov:file path, factory.hpp:47-48)."""
+        import json
+
+        path = tmp_path / "s.json"
+        path.write_text(json.dumps({"gamma": 1.4, "mTotal": 2.0}))
+        state, box, const = make_initializer(f"sedov:{path}")(6)
+        assert const.gamma == pytest.approx(1.4)
+        np.testing.assert_allclose(np.asarray(state.m).sum(), 2.0, rtol=1e-5)
+
 
 class TestNoh:
     def test_geometry_and_velocity(self):
