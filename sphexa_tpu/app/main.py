@@ -75,15 +75,19 @@ def main(argv=None) -> int:
     # built-in case names take precedence over same-named files, exactly
     # like make_initializer; a restart reads the snapshot ONCE, recovering
     # state, metadata and any checkpointed turbulence stirring state
-    from sphexa_tpu.init import CASES
+    from sphexa_tpu.init import CASES, split_case_spec
     from sphexa_tpu.init.file_init import looks_like_file, parse_file_spec
-
-    from sphexa_tpu.init import split_case_spec
 
     log = (lambda *a, **k: None) if args.quiet else print
     # 'case:settings.json' selects the case with overrides; observables key
-    # on the bare case name
-    case_name, _ = split_case_spec(args.init)
+    # on the bare case name (with the overrides applied to their thresholds)
+    case_name, settings_path = split_case_spec(args.init)
+    case_overrides = None
+    if settings_path is not None:
+        import json
+
+        with open(settings_path) as f:
+            case_overrides = json.load(f)
     is_restart = args.init not in CASES and looks_like_file(args.init)
     turb_state, turb_cfg, restart_iteration = None, None, 0
     if is_restart:
@@ -124,7 +128,7 @@ def main(argv=None) -> int:
     # observable selected by the test case (observables/factory.hpp:46-70) —
     # on restart, by the case name the snapshot recorded; field-consuming
     # observables read rho/c straight from the step diagnostics
-    observable = make_observable(case_name)
+    observable = make_observable(case_name, overrides=case_overrides)
     sim = Simulation(state, box, const, prop=args.prop,
                      av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
                      turb_state=turb_state, turb_cfg=turb_cfg,

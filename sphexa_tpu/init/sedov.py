@@ -36,6 +36,13 @@ def init_sedov(
     settings = sedov_constants()
     if overrides:
         settings.update(overrides)
+        if "ener0" not in overrides:
+            # re-derive the spike amplitude from the (possibly overridden)
+            # energyTotal/width — ener0 precomputed in sedov_constants()
+            # would silently pin the default blast energy
+            settings["ener0"] = (
+                settings["energyTotal"] / np.pi**1.5 / settings["width"] ** 3
+            )
 
     n = side**3
     r = settings["r1"]

@@ -83,14 +83,16 @@ class WindBubble:
         ]
 
 
-def make_observable(case: str):
+def make_observable(case: str, overrides: Optional[Dict[str, float]] = None):
     """Observable for a test case, keyed like the reference factory (which
     keys on the marker entries the init settings plant, factory.hpp:46-70:
-    'kelvin-helmholtz', 'wind-shock', 'turbulence')."""
+    'kelvin-helmholtz', 'wind-shock', 'turbulence'). ``overrides`` are the
+    case's settings-file overrides, so threshold-bearing observables match
+    the actual setup."""
     if case == "kelvin-helmholtz":
         return TimeEnergyGrowth()
     if case == "wind-shock":
-        return WindBubble(wind_shock_constants())
+        return WindBubble(dict(wind_shock_constants(), **(overrides or {})))
     if case == "turbulence":
         return TurbulenceMachRMS()
     return TimeAndEnergy()

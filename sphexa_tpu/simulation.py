@@ -82,14 +82,20 @@ def make_propagator_config(
     order = native.argsort_keys(keys)
     from sphexa_tpu.neighbors.cell_list import pad_cap, window_cells
 
-    cap = max(pad_cap(native.max_cell_occupancy(keys[order], level)), min_cap)
+    cap = pad_cap(native.max_cell_occupancy(keys[order], level))
+    if min_cap > 0:
+        cap = max(cap, pad_cap(min_cap))  # quantized so retry caps cache
     group = 128  # must match the pallas engine's GROUP
     ncell = 1 << level
     ext = native.group_extents(xa, ya, za, order, group)
-    radius = 4.0 * h_max
+    # 10% radius slack absorbs drift between reconfigurations; a whole
+    # margin cell costs ~2x window cells (every cell is a kernel iteration),
+    # and the window_ok guard reconfigures if the slack is ever outgrown
+    radius = 4.0 * h_max * 1.1
     window = 1
     for e, edge in zip(ext, lengths / ncell):
-        window = max(window, window_cells(e, radius, float(edge), ncell))
+        window = max(window, window_cells(e, radius, float(edge), ncell,
+                                          margin_cells=0))
     nbr = NeighborConfig(
         level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block,
         curve=curve, group=group, window=window,
@@ -299,13 +305,19 @@ class Simulation:
             }
             fetched = jax.device_get(scalars)
             diagnostics = {**diagnostics, **fetched}
-            nbr_over = int(diagnostics["occupancy"]) > self._cfg.nbr.cap
+            occ = int(diagnostics["occupancy"])
+            nbr_over = occ > self._cfg.nbr.cap
             grav_over = self._gravity_overflowed(diagnostics)
             if not nbr_over and not grav_over:
                 break
             grav_margin *= 1.5 if grav_over else 1.0
+            # occ == cap+1 is the window-blowout SENTINEL, not a real
+            # occupancy — feeding it back as min_cap would ratchet the cap
+            # (and force a fresh compile) on every blowout; a plain
+            # re-estimate resizes the window instead
+            window_blown = occ == self._cfg.nbr.cap + 1
             self._configure(
-                min_cap=int(diagnostics["occupancy"]), grav_margin=grav_margin
+                min_cap=0 if window_blown else occ, grav_margin=grav_margin
             )
             reconfigured = True
         else:

@@ -46,6 +46,19 @@ class TestFactory:
         assert const.gamma == pytest.approx(1.4)
         np.testing.assert_allclose(np.asarray(state.m).sum(), 2.0, rtol=1e-5)
 
+    def test_sedov_derived_energy_override(self, tmp_path):
+        """Overriding energyTotal must re-derive the spike amplitude
+        (ener0), not keep the default blast energy."""
+        import json
+
+        path = tmp_path / "e.json"
+        path.write_text(json.dumps({"energyTotal": 2.0}))
+        s1, _, c1 = make_initializer(f"sedov:{path}")(6)
+        s0, _, c0 = make_initializer("sedov")(6)
+        u1 = (np.asarray(s1.temp) * c1.cv * np.asarray(s1.m)).sum()
+        u0 = (np.asarray(s0.temp) * c0.cv * np.asarray(s0.m)).sum()
+        assert u1 / u0 == pytest.approx(2.0, rel=1e-3)
+
 
 class TestNoh:
     def test_geometry_and_velocity(self):
