@@ -1,0 +1,77 @@
+"""Quick interpret-mode equivalence check of the pallas engine vs the XLA
+gather path (CPU, small Sedov). Dev harness; the CI version lives in
+tests/test_pallas_interpret.py."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.neighbors.cell_list import find_neighbors
+from sphexa_tpu.propagator import _sort_by_keys
+from sphexa_tpu.simulation import make_propagator_config
+from sphexa_tpu.sph import hydro_std
+from sphexa_tpu.sph import pallas_pairs as pp
+
+
+def main():
+    state, box, const = init_sedov(14)
+    cfg = make_propagator_config(state, box, const, block=4096, backend="pallas")
+    ss, keys, _ = _sort_by_keys(state, box, "hilbert")
+    nbr = cfg.nbr
+    print(f"n={state.n} level={nbr.level} cap={nbr.cap} window={nbr.window}")
+
+    nidx, nmask, nc0, occ0 = find_neighbors(ss.x, ss.y, ss.z, ss.h, keys, box, nbr)
+    rho0 = hydro_std.compute_density(
+        ss.x, ss.y, ss.z, ss.h, ss.m, nidx, nmask, box, const, 4096
+    )
+
+    ranges = pp.group_cell_ranges(ss.x, ss.y, ss.z, ss.h, keys, box, nbr)
+    print("ncells mean/max:", float(jnp.mean(ranges.ncells.astype(jnp.float32))),
+          int(jnp.max(ranges.ncells)), "of", nbr.window ** 3,
+          "occ", int(ranges.occupancy))
+    rho1, nc1, occ = pp.pallas_density(
+        ss.x, ss.y, ss.z, ss.h, ss.m, keys, box, const, nbr,
+        ranges=ranges, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(nc1), np.asarray(nc0))
+    np.testing.assert_allclose(np.asarray(rho1), np.asarray(rho0), rtol=1e-5)
+    print("density OK")
+
+    p, c = hydro_std.compute_eos_std(ss.temp, rho0, const)
+    cs0 = hydro_std.compute_iad(
+        ss.x, ss.y, ss.z, ss.h, ss.m / rho0, nidx, nmask, box, const, 4096
+    )
+    cs1, _ = pp.pallas_iad(
+        ss.x, ss.y, ss.z, ss.h, ss.m / rho0, keys, box, const, nbr,
+        ranges=ranges, interpret=True,
+    )
+    scale = float(jnp.max(jnp.abs(cs0[0])))
+    for a, b in zip(cs0, cs1):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-5 * scale, rtol=1e-4
+        )
+    print("iad OK")
+
+    me0 = hydro_std.compute_momentum_energy_std(
+        ss.x, ss.y, ss.z, ss.vx, ss.vy, ss.vz, ss.h, ss.m, rho0, p, c,
+        *cs0, nidx, nmask, box, const, 4096,
+    )
+    *me1, _ = pp.pallas_momentum_energy_std(
+        ss.x, ss.y, ss.z, ss.vx, ss.vy, ss.vz, ss.h, ss.m, rho0, p, c,
+        *cs1, keys, box, const, nbr, ranges=ranges, interpret=True,
+    )
+    for a, b in zip(me0[:4], me1[:4]):
+        s = float(jnp.max(jnp.abs(a))) + 1e-12
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-6 * s, rtol=1e-4
+        )
+    assert abs(float(me1[4]) - float(me0[4])) < 1e-5 * abs(float(me0[4]))
+    print("momentum OK")
+
+
+if __name__ == "__main__":
+    main()

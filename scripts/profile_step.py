@@ -1,0 +1,120 @@
+"""Step-time breakdown of the std Pallas pipeline (perf work harness).
+
+Times each stage of the hot loop separately on the current default device:
+SFC keygen+argsort, the group cell-range prologue, and each pallas op.
+The analog of the reference's per-substep Timer printout
+(main/src/util/timer.hpp:46-52) for offline perf work.
+
+Usage: [PROF_SIDE=100] [PROF_ITERS=5] python scripts/profile_step.py
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.simulation import Simulation, make_propagator_config
+from sphexa_tpu.sfc.box import make_global_box
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+from sphexa_tpu.sph import hydro_std
+from sphexa_tpu.sph import pallas_pairs as pp
+
+SIDE = int(os.environ.get("PROF_SIDE", "100"))
+ITERS = int(os.environ.get("PROF_ITERS", "5"))
+
+
+def timeit(name, fn, *args, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"  {name:28s} {dt * 1e3:9.2f} ms")
+    return out, dt
+
+
+def main():
+    n = SIDE**3
+    state, box, const = init_sedov(SIDE)
+    sim = Simulation(state, box, const, prop="std", block=8192)
+    # settle the config with a couple of real steps
+    for _ in range(2):
+        sim.step()
+    state, box, cfg = sim.state, sim.box, sim._cfg
+    nbr = cfg.nbr
+    print(f"n={n}  level={nbr.level} cap={nbr.cap} window={nbr.window} "
+          f"backend={cfg.backend}")
+
+    box = make_global_box(state.x, state.y, state.z, box)
+
+    total = 0.0
+
+    @jax.jit
+    def keys_and_sort(state):
+        keys = compute_sfc_keys(state.x, state.y, state.z, box, curve=cfg.curve)
+        order = jnp.argsort(keys)
+        return keys[order], order
+
+    (skeys, order), dt = timeit("keygen+argsort", keys_and_sort, state)
+    total += dt
+
+    @jax.jit
+    def gather_all(state, order):
+        import dataclasses as dc
+        f = lambda a: a[order] if a.ndim == 1 and a.shape[0] == state.n else a
+        return jax.tree.map(f, state)
+
+    state, dt = timeit("field gather (17 arrays)", gather_all, state, order)
+    total += dt
+    x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
+
+    ranges_fn = jax.jit(
+        lambda x, y, z, h, k: pp.group_cell_ranges(x, y, z, h, k, box, nbr)
+    )
+    ranges, dt = timeit("group_cell_ranges", ranges_fn, x, y, z, h, skeys)
+    total += dt
+
+    dens = jax.jit(
+        lambda *a: pp.pallas_density(*a, box, const, nbr, ranges=ranges)
+    )
+    (rho, nc, occ), dt = timeit("pallas_density", dens, x, y, z, h, m, skeys)
+    total += dt
+
+    eos = jax.jit(lambda t, r: hydro_std.compute_eos_std(t, r, const))
+    (p, c), dt = timeit("eos", eos, state.temp, rho)
+    total += dt
+
+    iad = jax.jit(
+        lambda *a: pp.pallas_iad(*a, box, const, nbr, ranges=ranges)
+    )
+    (cij, _), dt = timeit("pallas_iad", iad, x, y, z, h, m / rho, skeys)
+    total += dt
+
+    mom = jax.jit(
+        lambda *a: pp.pallas_momentum_energy_std(
+            *a, skeys, box, const, nbr, ranges=ranges
+        )
+    )
+    out, dt = timeit(
+        "pallas_momentum", mom, x, y, z, state.vx, state.vy, state.vz,
+        h, m, rho, p, c, *cij,
+    )
+    total += dt
+
+    print(f"  {'SUM of stages':28s} {total * 1e3:9.2f} ms")
+
+    t0 = time.perf_counter()
+    for _ in range(3):
+        sim.step()
+    jax.block_until_ready(sim.state.x)
+    full = (time.perf_counter() - t0) / 3
+    print(f"  {'full Simulation.step':28s} {full * 1e3:9.2f} ms")
+    print(f"  updates/s: {n / full:,.0f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -178,7 +178,7 @@ def _std_forces(
         from sphexa_tpu.sph import pallas_pairs as pp
 
         ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
-        occ = ranges[2]
+        occ = ranges.occupancy
         rho, nc, _ = pp.pallas_density(
             x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges
         )

@@ -7,12 +7,24 @@ neighbors found on the fly inside each kernel, no stored lists) mapped to
 the TPU memory system:
 
 - targets are groups of G = 128 SFC-consecutive particles (one VMEM block);
-- the group's candidate set is the static ``window^3`` block of grid cells
-  covering its search extent; every cell's particles are CONTIGUOUS in the
-  SFC-sorted arrays, so each cell is ONE dynamic-slice DMA from HBM into a
-  VMEM ring buffer — no XLA gathers anywhere;
-- the pair physics runs cell-by-cell on (G, cap) tiles on the VPU while
-  the next cell's DMA is in flight (double buffering);
+- the group's candidate cells are found in a jax-side prologue
+  (``group_cell_ranges``): the static ``window^3`` block of grid cells
+  covering the group's search extent is CULLED by exact cell-AABB vs
+  group-bbox distance and COMPACTED, so the kernel loops over only the
+  ~dozen cells that can actually contain neighbors (the analog of the
+  reference's per-warp tree traversal pruning, find_neighbors.cuh:45-82);
+- every surviving cell's particles are CONTIGUOUS in the SFC-sorted
+  arrays, and all the op's j-side fields are pre-packed into ONE
+  interleaved (rows, nfields, 128) HBM buffer, so each cell is ONE
+  dynamic-slice DMA into a VMEM ring buffer regardless of how many fields
+  the op consumes — no XLA gathers anywhere, no per-field DMA storms;
+- the pair physics runs chunk-by-chunk on (G, 128) tiles on the VPU while
+  the next cell's DMA is in flight (double buffering); the number of
+  128-wide chunks per cell is dynamic (ceil(len/128)), so padded cap
+  slack costs no FLOPs;
+- periodic images are handled by a per-cell precomputed shift (each
+  window cell corresponds to exactly one box image), replacing per-pair
+  minimum-image folds;
 - each op instantiates the shared engine with its own per-pair math and
   accumulators, fusing neighbor search INTO the op (the reference GPU
   does exactly this, SURVEY.md §2 'neighbors recomputed on the fly').
@@ -33,7 +45,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
 from sphexa_tpu.neighbors.cell_list import NeighborConfig, _window_offsets
-from sphexa_tpu.sfc.box import Box
+from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sfc.hilbert import hilbert_encode
 from sphexa_tpu.sfc.morton import morton_encode
 
@@ -43,20 +55,59 @@ GROUP = 128  # targets per group: one f32 lane row
 class PairGeom(NamedTuple):
     """Per-(target, candidate) geometry handed to the pair body."""
 
-    rx: jax.Array     # (G, cap) x_i - x_j, minimum image
+    rx: jax.Array     # (G, 128) x_i - x_j, image-resolved
     ry: jax.Array
     rz: jax.Array
     d2: jax.Array     # squared distance
     mask: jax.Array   # valid pair: in-range candidate, within 2h_i, not self
 
 
-def group_cell_ranges(x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig):
-    """(starts, lens, occupancy) of every group's window cells.
+class GroupRanges(NamedTuple):
+    """Compacted candidate-cell lists of every target group (the engine's
+    shared prologue output; one per step, consumed by all pair ops)."""
 
-    Vectorized over all groups (the jax-side prologue both the engine and
-    find_neighbors share conceptually); starts index the SFC-sorted
-    arrays, lens <= cap. occupancy encodes the cap AND window guards like
-    find_neighbors.
+    starts: jax.Array     # (NG, W3) int32 — sorted-array offset of cell w
+    lens: jax.Array       # (NG, W3) int32 — particles in cell w (<= cap)
+    shift_x: jax.Array    # (NG, W3) f32 — periodic image offset of cell w
+    shift_y: jax.Array
+    shift_z: jax.Array
+    ncells: jax.Array     # (NG,) int32 — cells surviving the cull
+    occupancy: jax.Array  # () int32 — cap/window overflow diagnostic
+    boxl: jax.Array       # (3,) f32 — fold periods (1e30 on open dims);
+    # consumed only when the engine runs in fold mode (see engine_fold)
+
+    @property
+    def num_groups(self) -> int:
+        return self.starts.shape[0]
+
+
+def engine_fold(box: Box, cfg: NeighborConfig) -> bool:
+    """Static choice of the kernel's periodic-image strategy.
+
+    Per-cell shifts are exact when every needed cell *instance* fits in
+    the window (guaranteed by the window_ok guard whenever
+    window < ncell). When the window spans the whole grid — the tiny-grid
+    escape hatch where window_ok is forced true — a single instance per
+    wrapped cell cannot represent both images a target may need, so the
+    kernel must fall back to the per-pair minimum-image fold (and the
+    prologue must not distance-cull cells, since the kept instance's AABB
+    says nothing about its other image)."""
+    any_periodic = any(b == BoundaryType.periodic for b in box.boundaries)
+    return any_periodic and cfg.window >= (1 << cfg.level)
+
+
+def group_cell_ranges(
+    x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig
+) -> GroupRanges:
+    """Candidate cells of every group, culled and compacted.
+
+    Vectorized over all groups (the jax-side prologue all pair ops
+    share). A window cell survives when it (a) exists (periodic images
+    de-aliased, open-boundary cells inside the grid), (b) is non-empty,
+    and (c) its AABB intersects the group's bbox inflated by the group's
+    search radius 2*max(h). Survivors are compacted to the front so the
+    kernel's cell loop trips only ``ncells`` times. ``occupancy`` encodes
+    the cap AND window guards exactly like find_neighbors.
     """
     n = x.shape[0]
     level = cfg.level
@@ -92,21 +143,21 @@ def group_cell_ranges(x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig):
     window_ok = jnp.all((need_eff - base + 1 <= cfg.window) | (cfg.window >= ncell))
 
     offsets = jnp.asarray(_window_offsets(cfg.window))  # (W3, 3)
-    cells = base[:, None, :] + offsets[None, :, :]  # (NG, W3, 3)
+    cells = base[:, None, :] + offsets[None, :, :]  # (NG, W3, 3) unwrapped
     wrapped = jnp.mod(cells, ncell)
     in_range = (cells >= 0) & (cells < ncell)
     unique = offsets[None, :, :] < ncell
     cell_ok = jnp.all(
         jnp.where(periodic[None, None, :], unique, in_range), axis=-1
     )  # (NG, W3)
-    cells = jnp.where(
+    lookup = jnp.where(
         periodic[None, None, :], wrapped, jnp.clip(cells, 0, ncell - 1)
     )
 
     ckey = encode(
-        cells[..., 0].astype(KEY_DTYPE),
-        cells[..., 1].astype(KEY_DTYPE),
-        cells[..., 2].astype(KEY_DTYPE),
+        lookup[..., 0].astype(KEY_DTYPE),
+        lookup[..., 1].astype(KEY_DTYPE),
+        lookup[..., 2].astype(KEY_DTYPE),
         bits=level,
     )
     start = jnp.searchsorted(sorted_keys, ckey << shift).astype(jnp.int32)
@@ -114,24 +165,90 @@ def group_cell_ranges(x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig):
         jnp.int32
     )
     raw_len = end - start
-    occupancy = jnp.where(window_ok, jnp.max(raw_len), jnp.int32(cfg.cap + 1))
     lens = jnp.where(cell_ok, jnp.minimum(raw_len, cfg.cap), 0)
-    return start, lens, occupancy
+
+    if engine_fold(box, cfg):
+        # tiny-grid fallback: the kernel min-image-folds every pair, so
+        # image-position culling is meaningless — keep all non-empty cells
+        keep = cell_ok & (lens > 0)
+        shifts = jnp.zeros(cells.shape, jnp.float32)
+    else:
+        # cull: drop cells whose AABB (at their image position) cannot
+        # contain any neighbor of the group — exact box-vs-box distance
+        # test against the group bbox inflated by its search radius
+        cell_lo = (
+            box_lo[None, None, :] + cells.astype(jnp.float32) * edge[None, None, :]
+        )
+        cell_hi = cell_lo + edge[None, None, :]
+        r = radius[:, None, None]
+        overlap = jnp.all(
+            (cell_hi >= lo[:, None, :] - r) & (cell_lo <= hi[:, None, :] + r),
+            axis=-1,
+        )  # (NG, W3)
+        keep = cell_ok & overlap & (lens > 0)
+
+        # each window cell corresponds to exactly ONE box image: its offset
+        # resolves periodicity for every pair in the cell (no per-pair fold)
+        img = jnp.floor_divide(cells, ncell).astype(jnp.float32)  # (NG, W3, 3)
+        shifts = img * box.lengths[None, None, :]
+
+    # compact survivors to the front (stable: preserves SFC cell order)
+    order = jnp.argsort(jnp.logical_not(keep), axis=1, stable=True)
+    take = lambda a: jnp.take_along_axis(a, order, axis=1)
+    starts_c = take(start)
+    keep_c = take(keep)
+    lens_c = jnp.where(keep_c, take(lens), 0)
+    # dead slots DMA row 0 harmlessly (len 0 masks every pair)
+    starts_c = jnp.where(keep_c, starts_c, 0)
+    sh = [jnp.where(keep_c, take(shifts[..., d]), 0.0) for d in range(3)]
+    ncells = jnp.sum(keep, axis=1).astype(jnp.int32)
+
+    # cap overflow only matters for cells the kernel will visit: a culled
+    # cell's clipped length truncates nothing
+    occupancy = jnp.where(
+        window_ok,
+        jnp.max(jnp.where(keep, raw_len, 0)),
+        jnp.int32(cfg.cap + 1),
+    )
+
+    # fold periods: open dims get an effectively-infinite period so the
+    # fold is a no-op there (only consumed in fold mode)
+    boxl = jnp.where(box.periodic_mask, box.lengths, jnp.float32(1e30))
+
+    return GroupRanges(
+        starts=starts_c, lens=lens_c,
+        shift_x=sh[0], shift_y=sh[1], shift_z=sh[2],
+        ncells=ncells, occupancy=occupancy, boxl=boxl.astype(jnp.float32),
+    )
 
 
 def _round_up(v: int, q: int) -> int:
     return -(-v // q) * q
 
 
-def _dma_geometry(cap: int):
-    """(span, buf_rows): each cell range [s, s+len) is covered by an
-    8-row-aligned DMA window of buf_rows rows; the valid range sits at
-    offset s % 128 within the first ``span`` slots. SINGLE source of truth
-    — the kernel's transfer shape and _prep's tail padding must agree or
-    the DMA reads out of bounds."""
-    span = _round_up(128 + cap, 128)
-    buf_rows = max(8, _round_up(span, 1024) // 128)
-    return span, buf_rows
+def _dma_rows(cap: int) -> int:
+    """Rows of 128 covering any cell range [s, s+len<=cap): the range
+    starts at lane offset s%128 inside row s//128 and extends at most
+    127+cap slots, i.e. ceil((127+cap)/128) rows. SINGLE source of truth —
+    the kernel's transfer shape and pack_j_fields' tail padding must
+    agree or the DMA reads out of bounds."""
+    return -(-(127 + cap) // 128)
+
+
+def pack_j_fields(fields: Sequence[jax.Array], cap: int) -> jax.Array:
+    """Interleave the j-side fields into one (rows, nf_pad, 128) HBM
+    buffer: slot j of field f lives at [j // 128, f, j % 128], so one
+    dynamic row-slice DMA fetches EVERY field of a candidate cell.
+    The tail is padded by a full DMA window so a range starting at the
+    last particle still reads in-bounds garbage (masked); nf is padded
+    to the f32 sublane quantum."""
+    n = fields[0].shape[0]
+    nf = len(fields)
+    nf_pad = _round_up(nf, 8)
+    rows = -(-n // 128) + _dma_rows(cap)
+    flat = jnp.zeros((nf_pad, rows * 128), jnp.float32)
+    flat = flat.at[:nf, :n].set(jnp.stack(fields))
+    return flat.reshape(nf_pad, rows, 128).transpose(1, 0, 2)
 
 
 def group_pair_engine(
@@ -141,110 +258,126 @@ def group_pair_engine(
     num_j: int,
     num_acc: int,
     cfg: NeighborConfig,
+    fold: bool = False,
     interpret: bool = False,
 ):
     """Build a pallas_call for one SPH pair op.
 
-    - ``pair_body(geom, i_fields, j_fields, accs) -> accs``: per-cell pair
-      math on (G, cap) tiles; i_fields are (G, 1) columns, j_fields are
-      (1, cap) rows; accs is a tuple of (G, 1) f32 accumulators.
+    - ``pair_body(geom, i_fields, j_fields, accs) -> accs``: per-chunk pair
+      math on (G, 128) tiles; i_fields are (G, 1) columns, j_fields are
+      (1, 128) rows; accs is a tuple of (G, 1) f32 accumulators.
     - ``finalize(i_fields, accs, nc) -> outs``: per-target epilogue; outs
       is a tuple of (G,) arrays (f32), one per output.
-    - ``num_i``/``num_j``: how many target/candidate fields follow
-      (x, y, z, h are always fields 0-3 on both sides).
-    - returns fn(starts, lens, boxl, i_fields(NG,G) x num_i,
-      j_fields(n_pad,) x num_j) -> (outs (NG, G) x num_out, nc (NG, G)).
+    - ``num_i``/``num_j``: how many target/candidate fields the op reads
+      (x, y, z are always fields 0-2 on both sides; h is i-field 3).
+    - returns fn(ranges, i_fields(NG,G) x num_i, j_packed) ->
+      (outs (NG, G) x num_out, nc (NG, G)).
     """
     w3 = cfg.window**3
-    span, buf_rows = _dma_geometry(cfg.cap)
+    R = _dma_rows(cfg.cap)
+    nf_pad = _round_up(num_j, 8)
 
     def kernel(*refs):
-        starts, lens, boxl = refs[0], refs[1], refs[2]
-        i_refs = refs[3 : 3 + num_i]
-        j_refs = refs[3 + num_i : 3 + num_i + num_j]
-        out_refs = refs[3 + num_i + num_j : -2 - num_j]
-        nc_ref = refs[-2 - num_j]
-        bufs = refs[-1 - num_j : -1]
-        sems = refs[-1]
+        starts, lens, shx_r, shy_r, shz_r, ncells, boxl = refs[:7]
+        i_refs = refs[7 : 7 + num_i]
+        jref = refs[7 + num_i]
+        out_refs = refs[8 + num_i : -2]
+        nc_ref = refs[-2]
+        buf, sems = refs[-1]  # unpacked below
 
         gi = pl.program_id(0)
         G = GROUP
 
+        nc_g = ncells[0, 0, 0]
+
         def dma(w, slot):
             row_s = starts[0, 0, w] // 128
-            return [
-                pltpu.make_async_copy(
-                    j_refs[f].at[pl.ds(row_s, buf_rows), :],
-                    bufs[f].at[slot],
-                    sems.at[slot, f],
-                )
-                for f in range(num_j)
-            ]
+            return pltpu.make_async_copy(
+                jref.at[pl.ds(row_s, R), :, :], buf.at[slot], sems.at[slot]
+            )
 
-        for d in dma(0, 0):
-            d.start()
+        @pl.when(nc_g > 0)
+        def _():
+            dma(0, 0).start()
 
         i_fields = [r[0, 0][:, None] for r in i_refs]  # (G, 1) each
         xi, yi, zi, hi = i_fields[:4]
-        lx, ly, lz = boxl[0, 0, 0], boxl[0, 0, 1], boxl[0, 0, 2]
         tgt_idx = gi * G + jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
-        span_iota = jax.lax.broadcasted_iota(jnp.int32, (1, span), 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+        h4 = 4.0 * hi * hi
+        lx, ly, lz = boxl[0, 0, 0], boxl[0, 0, 1], boxl[0, 0, 2]
 
-        def body(w, carry):
+        def cell_body(w, carry):
             accs, nc_acc = carry
             slot = w % 2
 
-            @pl.when(w + 1 < w3)
+            @pl.when(w + 1 < nc_g)
             def _():
-                for d in dma(w + 1, (w + 1) % 2):
-                    d.start()
+                dma(w + 1, 1 - slot).start()
 
-            for d in dma(w, slot):
-                d.wait()
+            dma(w, slot).wait()
 
             s = starts[0, 0, w]
             ln = lens[0, 0, w]
-            off = s - (s // 128) * 128
-            j_fields = [
-                bufs[f][slot].reshape(1, buf_rows * 128)[:, :span]
-                for f in range(num_j)
-            ]  # (1, span)
-            cx, cy, cz = j_fields[0], j_fields[1], j_fields[2]
+            shx = shx_r[0, 0, w]
+            shy = shy_r[0, 0, w]
+            shz = shz_r[0, 0, w]
+            row0 = s // 128
+            off = s - row0 * 128
+            nch = (off + ln + 127) // 128
 
-            rx = xi - cx
-            ry = yi - cy
-            rz = zi - cz
-            rx = rx - lx * jnp.round(rx / lx)
-            ry = ry - ly * jnp.round(ry / ly)
-            rz = rz - lz * jnp.round(rz / lz)
-            d2 = rx * rx + ry * ry + rz * rz
+            def chunk_body(c, carry2):
+                accs, nc_acc = carry2
+                chunk = buf[slot, c]  # (nf_pad, 128)
+                j_fields = [chunk[f][None, :] for f in range(num_j)]
+                if fold:
+                    # tiny-grid path: shifts are all zero, fold per pair
+                    rx = xi - j_fields[0]
+                    ry = yi - j_fields[1]
+                    rz = zi - j_fields[2]
+                    rx = rx - lx * jnp.round(rx / lx)
+                    ry = ry - ly * jnp.round(ry / ly)
+                    rz = rz - lz * jnp.round(rz / lz)
+                else:
+                    rx = xi - (j_fields[0] + shx)
+                    ry = yi - (j_fields[1] + shy)
+                    rz = zi - (j_fields[2] + shz)
+                d2 = rx * rx + ry * ry + rz * rz
+                cand = (row0 + c) * 128 + lane
+                mask = (
+                    (cand >= s) & (cand < s + ln)
+                    & (d2 < h4) & (cand != tgt_idx)
+                )
+                geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
+                accs = pair_body(geom, i_fields, j_fields, accs)
+                nc_acc = nc_acc + jnp.sum(mask, axis=1, keepdims=True)
+                return accs, nc_acc
 
-            cand_idx = (s - off) + span_iota
-            mask = (
-                (span_iota >= off)
-                & (span_iota < off + ln)
-                & (d2 < 4.0 * hi * hi)
-                & (cand_idx != tgt_idx)
-            )
-            geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
-            accs = pair_body(geom, i_fields, j_fields, accs)
-            nc_acc = nc_acc + jnp.sum(mask, axis=1, keepdims=True)
-            return accs, nc_acc
+            return jax.lax.fori_loop(0, nch, chunk_body, (accs, nc_acc))
 
         acc0 = tuple(jnp.zeros((G, 1), jnp.float32) for _ in range(num_acc))
         nc0 = jnp.zeros((G, 1), jnp.int32)
-        accs, nc_acc = jax.lax.fori_loop(0, w3, body, (acc0, nc0))
+        accs, nc_acc = jax.lax.fori_loop(0, nc_g, cell_body, (acc0, nc0))
 
         outs = finalize(i_fields, accs, nc_acc)
         for r, o in zip(out_refs, outs):
             r[0, 0] = o.reshape(GROUP)
         nc_ref[0, 0] = nc_acc.reshape(GROUP)
 
-    def call(starts, lens, boxl, i_fields: Sequence, j_fields: Sequence):
-        num_groups = starts.shape[0]
-        starts = starts.reshape(num_groups, 1, w3)
-        lens = lens.reshape(num_groups, 1, w3)
-        boxl = boxl.reshape(1, 1, 3)
+    def scalar_kernel(*refs):
+        # scratch unpack shim: keep kernel() readable
+        kernel(*refs[:-2], (refs[-2], refs[-1]))
+
+    def call(ranges: GroupRanges, i_fields: Sequence, j_packed):
+        num_groups = ranges.num_groups
+        smem3 = lambda a: a.reshape(num_groups, 1, w3)
+        starts = smem3(ranges.starts)
+        lens = smem3(ranges.lens)
+        shx = smem3(ranges.shift_x)
+        shy = smem3(ranges.shift_y)
+        shz = smem3(ranges.shift_z)
+        ncells = ranges.ncells.reshape(num_groups, 1, 1)
+        boxl = ranges.boxl.reshape(1, 1, 3)
         i_fields = [a.reshape(num_groups, 1, GROUP) for a in i_fields]
         num_out_arrays = len(
             finalize(
@@ -253,54 +386,56 @@ def group_pair_engine(
                 jnp.zeros((GROUP, 1), jnp.int32),
             )
         )
+        smem_spec = lambda shape: pl.BlockSpec(
+            shape, lambda g: (g, 0, 0), memory_space=pltpu.SMEM
+        )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=0,
             grid=(num_groups,),
             in_specs=[
-                pl.BlockSpec((1, 1, w3), lambda g: (g, 0, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec((1, 1, w3), lambda g: (g, 0, 0), memory_space=pltpu.SMEM),
-                pl.BlockSpec((1, 1, 3), lambda g: (0, 0, 0), memory_space=pltpu.SMEM),
+                smem_spec((1, 1, w3)),  # starts
+                smem_spec((1, 1, w3)),  # lens
+                smem_spec((1, 1, w3)),  # shift x/y/z
+                smem_spec((1, 1, w3)),
+                smem_spec((1, 1, w3)),
+                smem_spec((1, 1, 1)),   # ncells
+                pl.BlockSpec((1, 1, 3), lambda g: (0, 0, 0),
+                             memory_space=pltpu.SMEM),  # boxl
             ]
             + [
                 pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))
                 for _ in range(num_i)
             ]
-            + [pl.BlockSpec(memory_space=pl.ANY) for _ in range(num_j)],
+            + [pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=[
                 pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))
                 for _ in range(num_out_arrays)
             ]
             + [pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))],
             scratch_shapes=[
-                pltpu.VMEM((2, buf_rows, 128), jnp.float32) for _ in range(num_j)
-            ]
-            + [pltpu.SemaphoreType.DMA((2, num_j))],
+                pltpu.VMEM((2, R, nf_pad, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
         )
         out_shape = [
             jax.ShapeDtypeStruct((num_groups, 1, GROUP), jnp.float32)
             for _ in range(num_out_arrays)
         ] + [jax.ShapeDtypeStruct((num_groups, 1, GROUP), jnp.int32)]
         outs = pl.pallas_call(
-            kernel,
+            scalar_kernel,
             grid_spec=grid_spec,
             out_shape=out_shape,
             interpret=interpret,
-        )(starts, lens, boxl, *i_fields, *j_fields)
+        )(starts, lens, shx, shy, shz, ncells, boxl, *i_fields, j_packed)
         return outs
 
     return call
 
 
-def _prep(x, y, z, h, extra_i, extra_j, box: Box, cfg: NeighborConfig):
-    """Common jax-side prologue: padded/blocked field layouts.
-
-    j-side fields are reshaped (rows, 128) so the kernel can DMA 8-row
-    aligned windows; the tail is padded by one full window so a range
-    starting at the last particle still reads in-bounds garbage (masked).
-    """
+def _prep_i(x, y, z, h, extra_i):
+    """Block the target-side fields (NG, GROUP); tail groups re-read the
+    last particle (masked out by the self/index tests)."""
     n = x.shape[0]
-    _, buf_rows = _dma_geometry(cfg.cap)
-    pad_tail = buf_rows * 128
     num_groups = -(-n // GROUP)
     pad_i = num_groups * GROUP - n
 
@@ -308,19 +443,7 @@ def _prep(x, y, z, h, extra_i, extra_j, box: Box, cfg: NeighborConfig):
         a = jnp.concatenate([a, jnp.broadcast_to(a[-1:], (pad_i,))]) if pad_i else a
         return a.reshape(num_groups, GROUP)
 
-    def pad_j(a):
-        rows = _round_up(n + pad_tail, 128) // 128
-        out = jnp.zeros(rows * 128, a.dtype)
-        return out.at[:n].set(a).reshape(rows, 128)
-
-    # open dims use an effectively-infinite period so the fold is a no-op
-    big = jnp.float32(1e30)
-    boxl = jnp.where(box.periodic_mask, box.lengths, big).astype(jnp.float32)
-    boxl = boxl.reshape(1, 3)
-
-    i_fields = [block_i(a) for a in (x, y, z, h, *extra_i)]
-    j_fields = [pad_j(a) for a in (x, y, z, *extra_j)]
-    return i_fields, j_fields, boxl, num_groups
+    return [block_i(a) for a in (x, y, z, h, *extra_i)]
 
 
 def pallas_density(
@@ -336,11 +459,8 @@ def pallas_density(
     sinc_n = _int_sinc_index(const)
     K = float(const.K)
 
-    starts, lens, occ = (
-        ranges
-        if ranges is not None
-        else group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
-    )
+    if ranges is None:
+        ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
         (rho_sum,) = accs
@@ -361,11 +481,12 @@ def pallas_density(
 
     engine = group_pair_engine(
         pair_body, finalize, num_i=5, num_j=4, num_acc=1, cfg=cfg,
-        interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret,
     )
-    i_fields, j_fields, boxl, _ = _prep(x, y, z, h, (m,), (m,), box, cfg)
-    rho, nc = engine(starts, lens, boxl, i_fields, j_fields)
-    return rho.reshape(-1)[:n], nc.reshape(-1)[:n], occ
+    i_fields = _prep_i(x, y, z, h, (m,))
+    jp = pack_j_fields((x, y, z, m), cfg.cap)
+    rho, nc = engine(ranges, i_fields, jp)
+    return rho.reshape(-1)[:n], nc.reshape(-1)[:n], ranges.occupancy
 
 
 def _int_sinc_index(const) -> int:
@@ -381,7 +502,7 @@ def _int_sinc_index(const) -> int:
 
 
 def _sinc_w(d2, hi, sinc_n: int):
-    """sinc^n kernel on (G, span) tiles from squared distance and h_i."""
+    """sinc^n kernel on (G, 128) tiles from squared distance and h_i."""
     v = jnp.sqrt(d2) / hi
     pv = (0.5 * np.pi) * v
     sinc = jnp.where(v > 0.0, jnp.sin(pv) / jnp.where(v > 0.0, pv, 1.0), 1.0)
@@ -402,11 +523,8 @@ def pallas_iad(
     sinc_n = _int_sinc_index(const)
     K = float(const.K)
 
-    starts, lens, occ = (
-        ranges
-        if ranges is not None
-        else group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
-    )
+    if ranges is None:
+        ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
         hi = i_fields[3]
@@ -449,11 +567,12 @@ def pallas_iad(
 
     engine = group_pair_engine(
         pair_body, finalize, num_i=4, num_j=4, num_acc=6, cfg=cfg,
-        interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret,
     )
-    i_fields, j_fields, boxl, _ = _prep(x, y, z, h, (), (vol,), box, cfg)
-    *cs, _nc = engine(starts, lens, boxl, i_fields, j_fields)
-    return tuple(c.reshape(-1)[:n] for c in cs), occ
+    i_fields = _prep_i(x, y, z, h, ())
+    jp = pack_j_fields((x, y, z, vol), cfg.cap)
+    *cs, _nc = engine(ranges, i_fields, jp)
+    return tuple(c.reshape(-1)[:n] for c in cs), ranges.occupancy
 
 
 def pallas_momentum_energy_std(
@@ -471,11 +590,8 @@ def pallas_momentum_energy_std(
     K = float(const.K)
     k_cour = float(const.k_cour)
 
-    starts, lens, occ = (
-        ranges
-        if ranges is not None
-        else group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
-    )
+    if ranges is None:
+        ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
         momx, momy, momz, energy, maxvs = accs
@@ -554,14 +670,15 @@ def pallas_momentum_energy_std(
 
     engine = group_pair_engine(
         pair_body, finalize, num_i=17, num_j=17, num_acc=5, cfg=cfg,
-        interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret,
     )
-    i_fields, j_fields, boxl, _ = _prep(
-        x, y, z, h,
-        (vx, vy, vz, c, rho, p, m, c11, c12, c13, c22, c23, c33),
-        (h, vx, vy, vz, c, rho, p, m, c11, c12, c13, c22, c23, c33),
-        box, cfg,
+    i_fields = _prep_i(
+        x, y, z, h, (vx, vy, vz, c, rho, p, m, c11, c12, c13, c22, c23, c33)
     )
-    ax, ay, az, du, dt_i, _nc = engine(starts, lens, boxl, i_fields, j_fields)
+    jp = pack_j_fields(
+        (x, y, z, h, vx, vy, vz, c, rho, p, m, c11, c12, c13, c22, c23, c33),
+        cfg.cap,
+    )
+    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp)
     f = lambda a: a.reshape(-1)[:n]
-    return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), occ
+    return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy
