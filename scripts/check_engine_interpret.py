@@ -1,5 +1,5 @@
 """Quick interpret-mode equivalence check of the pallas engine vs the XLA
-gather path (CPU, small Sedov). Dev harness; the CI version lives in
+gather path (CPU, small Sedov). Dev harness; the CI version is
 tests/test_pallas_interpret.py."""
 
 import os

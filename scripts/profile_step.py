@@ -9,15 +9,16 @@ Usage: [PROF_SIDE=100] [PROF_ITERS=5] python scripts/profile_step.py
 """
 
 import os
+import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
-import jax.numpy as jnp
 
 from sphexa_tpu.init import init_sedov
 from sphexa_tpu.simulation import Simulation, make_propagator_config
 from sphexa_tpu.sfc.box import make_global_box
-from sphexa_tpu.sfc.keys import compute_sfc_keys
 from sphexa_tpu.sph import hydro_std
 from sphexa_tpu.sph import pallas_pairs as pp
 
@@ -53,22 +54,13 @@ def main():
 
     total = 0.0
 
-    @jax.jit
-    def keys_and_sort(state):
-        keys = compute_sfc_keys(state.x, state.y, state.z, box, curve=cfg.curve)
-        order = jnp.argsort(keys)
-        return keys[order], order
-
-    (skeys, order), dt = timeit("keygen+argsort", keys_and_sort, state)
-    total += dt
+    from sphexa_tpu.propagator import _sort_by_keys
 
     @jax.jit
-    def gather_all(state, order):
-        import dataclasses as dc
-        f = lambda a: a[order] if a.ndim == 1 and a.shape[0] == state.n else a
-        return jax.tree.map(f, state)
+    def sort_state(state):
+        return _sort_by_keys(state, box, cfg.curve)[:2]
 
-    state, dt = timeit("field gather (17 arrays)", gather_all, state, order)
+    (state, skeys), dt = timeit("keygen+sort+gather", sort_state, state)
     total += dt
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 

@@ -48,6 +48,7 @@ from sphexa_tpu.neighbors.cell_list import NeighborConfig, _window_offsets
 from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sfc.hilbert import hilbert_encode
 from sphexa_tpu.sfc.morton import morton_encode
+from sphexa_tpu.sph.kernels import sinc_poly_coeffs, sinc_poly_eval
 
 GROUP = 128  # targets per group: one f32 lane row
 
@@ -160,10 +161,25 @@ def group_cell_ranges(
         lookup[..., 2].astype(KEY_DTYPE),
         bits=level,
     )
-    start = jnp.searchsorted(sorted_keys, ckey << shift).astype(jnp.int32)
-    end = jnp.searchsorted(sorted_keys, (ckey + KEY_DTYPE(1)) << shift).astype(
-        jnp.int32
-    )
+    if ncell**3 <= 4 * max(n, 1024):
+        # ONE cell-starts table for the whole grid, then per-(group, cell)
+        # range lookups are gathers from it — a binary search per window
+        # cell into the N-element u64 key array costs ~20 emulated-u64
+        # gathers each and dominated the prologue
+        cid = (sorted_keys >> shift).astype(jnp.int32)  # ascending
+        table = jnp.searchsorted(
+            cid, jnp.arange(ncell**3 + 1, dtype=jnp.int32)
+        ).astype(jnp.int32)
+        ck32 = ckey.astype(jnp.int32)
+        start = table[ck32]
+        end = table[ck32 + 1]
+    else:
+        # deep grids (possible when a caller bypasses the occupancy-driven
+        # level heuristic): the table would be O(8^level) — search instead
+        start = jnp.searchsorted(sorted_keys, ckey << shift).astype(jnp.int32)
+        end = jnp.searchsorted(
+            sorted_keys, (ckey + KEY_DTYPE(1)) << shift
+        ).astype(jnp.int32)
     raw_len = end - start
     lens = jnp.where(cell_ok, jnp.minimum(raw_len, cfg.cap), 0)
 
@@ -265,9 +281,13 @@ def group_pair_engine(
 
     - ``pair_body(geom, i_fields, j_fields, accs) -> accs``: per-chunk pair
       math on (G, 128) tiles; i_fields are (G, 1) columns, j_fields are
-      (1, 128) rows; accs is a tuple of (G, 1) f32 accumulators.
-    - ``finalize(i_fields, accs, nc) -> outs``: per-target epilogue; outs
-      is a tuple of (G,) arrays (f32), one per output.
+      (1, 128) rows; accs is a tuple of (G, 128) f32 LANE-WISE partial
+      accumulators — the body adds/maxes elementwise and must NOT reduce
+      (cross-lane reductions inside the chunk loop cost more than the pair
+      math; the epilogue reduces once).
+    - ``finalize(i_fields, accs, nc) -> outs``: per-target epilogue; accs
+      arrive unreduced (G, 128), nc is the reduced (G, 1) neighbor count;
+      outs is a tuple of (G,) arrays (f32), one per output.
     - ``num_i``/``num_j``: how many target/candidate fields the op reads
       (x, y, z are always fields 0-2 on both sides; h is i-field 3).
     - returns fn(ranges, i_fields(NG,G) x num_i, j_packed) ->
@@ -350,15 +370,16 @@ def group_pair_engine(
                 )
                 geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
                 accs = pair_body(geom, i_fields, j_fields, accs)
-                nc_acc = nc_acc + jnp.sum(mask, axis=1, keepdims=True)
+                nc_acc = nc_acc + mask.astype(jnp.int32)
                 return accs, nc_acc
 
             return jax.lax.fori_loop(0, nch, chunk_body, (accs, nc_acc))
 
-        acc0 = tuple(jnp.zeros((G, 1), jnp.float32) for _ in range(num_acc))
-        nc0 = jnp.zeros((G, 1), jnp.int32)
+        acc0 = tuple(jnp.zeros((G, 128), jnp.float32) for _ in range(num_acc))
+        nc0 = jnp.zeros((G, 128), jnp.int32)
         accs, nc_acc = jax.lax.fori_loop(0, nc_g, cell_body, (acc0, nc0))
 
+        nc_acc = jnp.sum(nc_acc, axis=1, keepdims=True)
         outs = finalize(i_fields, accs, nc_acc)
         for r, o in zip(out_refs, outs):
             r[0, 0] = o.reshape(GROUP)
@@ -446,6 +467,11 @@ def _prep_i(x, y, z, h, extra_i):
     return [block_i(a) for a in (x, y, z, h, *extra_i)]
 
 
+# W on (G, 128) tiles from u = d2/h^2: 14 FMAs, no sqrt/sin/div
+# (shared evaluator — both backends compute identical W)
+_w_poly = sinc_poly_eval
+
+
 def pallas_density(
     x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False,
@@ -456,7 +482,7 @@ def pallas_density(
     the search fused in. Returns (rho (n,), nc (n,), occupancy).
     """
     n = x.shape[0]
-    sinc_n = _int_sinc_index(const)
+    coeffs = sinc_poly_coeffs(float(const.sinc_index))
     K = float(const.K)
 
     if ranges is None:
@@ -464,52 +490,26 @@ def pallas_density(
 
     def pair_body(geom, i_fields, j_fields, accs):
         (rho_sum,) = accs
-        hi = i_fields[3]
+        inv_h2 = i_fields[4]
         mj = j_fields[3]
-        w = _sinc_w(geom.d2, hi, sinc_n)
-        rho_sum = rho_sum + jnp.sum(
-            jnp.where(geom.mask, mj * w, 0.0), axis=1, keepdims=True
-        )
-        return (rho_sum,)
+        w = _w_poly(geom.d2 * inv_h2, coeffs)
+        return (rho_sum + jnp.where(geom.mask, mj * w, 0.0),)
 
     def finalize(i_fields, accs, nc):
         hi = i_fields[3]
-        mi = i_fields[4]
-        (rho_sum,) = accs
+        mi = i_fields[5]
+        rho_sum = jnp.sum(accs[0], axis=1, keepdims=True)
         rho = K * (mi + rho_sum) / (hi * hi * hi)
         return (rho,)
 
     engine = group_pair_engine(
-        pair_body, finalize, num_i=5, num_j=4, num_acc=1, cfg=cfg,
+        pair_body, finalize, num_i=6, num_j=4, num_acc=1, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret,
     )
-    i_fields = _prep_i(x, y, z, h, (m,))
+    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m))
     jp = pack_j_fields((x, y, z, m), cfg.cap)
     rho, nc = engine(ranges, i_fields, jp)
     return rho.reshape(-1)[:n], nc.reshape(-1)[:n], ranges.occupancy
-
-
-def _int_sinc_index(const) -> int:
-    """The pallas kernels unroll the sinc power; fractional indices must
-    use the XLA backend."""
-    n = int(const.sinc_index)
-    if const.sinc_index != n:
-        raise ValueError(
-            f"pallas backend supports integer sinc indices only "
-            f"(got {const.sinc_index}); use backend='xla'"
-        )
-    return n
-
-
-def _sinc_w(d2, hi, sinc_n: int):
-    """sinc^n kernel on (G, 128) tiles from squared distance and h_i."""
-    v = jnp.sqrt(d2) / hi
-    pv = (0.5 * np.pi) * v
-    sinc = jnp.where(v > 0.0, jnp.sin(pv) / jnp.where(v > 0.0, pv, 1.0), 1.0)
-    w = sinc
-    for _ in range(sinc_n - 1):
-        w = w * sinc
-    return w
 
 
 def pallas_iad(
@@ -520,29 +520,28 @@ def pallas_iad(
     neighbor search fused in. ``vol`` is the per-particle volume estimate
     (m/rho std, xm/kx VE). Returns (c11..c33, occupancy)."""
     n = x.shape[0]
-    sinc_n = _int_sinc_index(const)
+    coeffs = sinc_poly_coeffs(float(const.sinc_index))
     K = float(const.K)
 
     if ranges is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
-        hi = i_fields[3]
+        inv_h2 = i_fields[4]
         vj = j_fields[3]
-        w = _sinc_w(geom.d2, hi, sinc_n)
+        w = _w_poly(geom.d2 * inv_h2, coeffs)
         vw = jnp.where(geom.mask, vj * w, 0.0)
         terms = (
             geom.rx * geom.rx, geom.rx * geom.ry, geom.rx * geom.rz,
             geom.ry * geom.ry, geom.ry * geom.rz, geom.rz * geom.rz,
         )
-        return tuple(
-            acc + jnp.sum(t * vw, axis=1, keepdims=True)
-            for acc, t in zip(accs, terms)
-        )
+        return tuple(acc + t * vw for acc, t in zip(accs, terms))
 
     def finalize(i_fields, accs, nc):
         hi = i_fields[3]
-        t11, t12, t13, t22, t23, t33 = accs
+        t11, t12, t13, t22, t23, t33 = (
+            jnp.sum(a, axis=1, keepdims=True) for a in accs
+        )
         # exponent renormalization (iad_kern.hpp ilogb/ldexp trick) via
         # exp2/log2 — exact because the factor cancels in adj/det
         exp_of = lambda v: jnp.where(
@@ -566,10 +565,10 @@ def pallas_iad(
         )
 
     engine = group_pair_engine(
-        pair_body, finalize, num_i=4, num_j=4, num_acc=6, cfg=cfg,
+        pair_body, finalize, num_i=5, num_j=4, num_acc=6, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret,
     )
-    i_fields = _prep_i(x, y, z, h, ())
+    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h),))
     jp = pack_j_fields((x, y, z, vol), cfg.cap)
     *cs, _nc = engine(ranges, i_fields, jp)
     return tuple(c.reshape(-1)[:n] for c in cs), ranges.occupancy
@@ -584,9 +583,14 @@ def pallas_momentum_energy_std(
     """Pressure-gradient accelerations + energy rate + Courant dt
     (hydro_std.compute_momentum_energy_std, momentum_energy_kern.hpp:12-134)
     with the neighbor search fused in. Returns (ax, ay, az, du, min_dt, occ).
+
+    The per-particle ratios the reference computes per PAIR
+    (momentum_energy_kern.hpp: p/rho^2, m/rho, 1/h^3) are precombined into
+    the i-columns / packed j-fields here, so the inner tile math has no
+    divisions and a single rsqrt.
     """
     n = x.shape[0]
-    sinc_n = _int_sinc_index(const)
+    coeffs = sinc_poly_coeffs(float(const.sinc_index))
     K = float(const.K)
     k_cour = float(const.k_cour)
 
@@ -595,36 +599,32 @@ def pallas_momentum_energy_std(
 
     def pair_body(geom, i_fields, j_fields, accs):
         momx, momy, momz, energy, maxvs = accs
-        (xi, yi, zi, hi, vxi, vyi, vzi, ci, rhoi, pi, mi,
+        (xi, yi, zi, hi, inv_h2i, inv_h3i, vxi, vyi, vzi, ci, pro_i, mi_roi,
          c11i, c12i, c13i, c22i, c23i, c33i) = i_fields
-        (cx, cy, cz, hj, vxj, vyj, vzj, cj, rhoj, pj, mj,
+        (cx, cy, cz, inv_h2j, vxj, vyj, vzj, cj, mj, mjroj3, pjroj,
          c11j, c12j, c13j, c22j, c23j, c33j) = j_fields
 
-        dist = jnp.sqrt(jnp.where(geom.mask, geom.d2, 1.0))
-        dist = jnp.where(geom.mask, dist, 1.0)
-        w_i = _sinc_w(geom.d2, hi, sinc_n) / (hi * hi * hi)
-        v2 = jnp.clip(dist / hj, 0.0, 2.0)
-        pv = (0.5 * np.pi) * v2
-        sincj = jnp.where(v2 > 0.0, jnp.sin(pv) / jnp.where(v2 > 0.0, pv, 1.0), 1.0)
-        w_j = sincj
-        for _ in range(sinc_n - 1):
-            w_j = w_j * sincj
-        w_j = w_j / (hj * hj * hj)
+        w_i = _w_poly(geom.d2 * inv_h2i, coeffs) * inv_h3i
+        # support clamp inside _w_poly zeroes pairs beyond 2 h_j, matching
+        # the reference's table lookup clamp
+        mjw = mjroj3 * _w_poly(geom.d2 * inv_h2j, coeffs)  # m_j/rho_j W_j
 
+        # self/masked pairs have d2 = 0 -> rsqrt = inf -> NaNs confined to
+        # masked lanes; every accumulation below selects on geom.mask
+        inv_dist = jax.lax.rsqrt(geom.d2)
         vx_ij = vxi - vxj
         vy_ij = vyi - vyj
         vz_ij = vzi - vzj
         rv = geom.rx * vx_ij + geom.ry * vy_ij + geom.rz * vz_ij
-        w_ij = rv / dist
+        w_ij = rv * inv_dist
 
         # Monaghan constant-alpha AV, halved per pair (kernels.hpp:60-84)
-        v_signal = 0.5 * (ci + cj) - 2.0 * w_ij
+        cij = ci + cj
+        v_signal = 0.5 * cij - 2.0 * w_ij
         visc = 0.5 * jnp.where(w_ij < 0.0, -v_signal * w_ij, 0.0)
 
-        vijsignal = ci + cj - 3.0 * w_ij
         maxvs = jnp.maximum(
-            maxvs, jnp.max(jnp.where(geom.mask, vijsignal, 0.0), axis=1,
-                           keepdims=True)
+            maxvs, jnp.where(geom.mask, cij - 3.0 * w_ij, 0.0)
         )
 
         tA1_i = c11i * geom.rx + c12i * geom.ry + c13i * geom.rz
@@ -634,49 +634,51 @@ def pallas_momentum_energy_std(
         tA2_j = c12j * geom.rx + c22j * geom.ry + c23j * geom.rz
         tA3_j = c13j * geom.rx + c23j * geom.ry + c33j * geom.rz
 
-        mj_pro_i = mj * pi / (rhoi * rhoi)
-        mj_roj_wj = mj / rhoj * w_j
-        mi_roi = mi / rhoi
-
-        a = w_i * (mj_pro_i + visc * mi_roi)
-        b = mj_roj_wj * (pj / rhoj + visc)
+        mj_pro_i = mj * pro_i
+        vmi = visc * mi_roi
+        a = w_i * (mj_pro_i + vmi)
+        b = mjw * (pjroj + visc)
         mm = geom.mask
-        momx = momx + jnp.sum(jnp.where(mm, a * tA1_i + b * tA1_j, 0.0), 1, keepdims=True)
-        momy = momy + jnp.sum(jnp.where(mm, a * tA2_i + b * tA2_j, 0.0), 1, keepdims=True)
-        momz = momz + jnp.sum(jnp.where(mm, a * tA3_i + b * tA3_j, 0.0), 1, keepdims=True)
+        momx = momx + jnp.where(mm, a * tA1_i + b * tA1_j, 0.0)
+        momy = momy + jnp.where(mm, a * tA2_i + b * tA2_j, 0.0)
+        momz = momz + jnp.where(mm, a * tA3_i + b * tA3_j, 0.0)
 
-        a_e = w_i * (2.0 * mj_pro_i + visc * mi_roi)
-        b_e = visc * mj_roj_wj
-        energy = energy + jnp.sum(
-            jnp.where(
-                mm,
-                vx_ij * (a_e * tA1_i + b_e * tA1_j)
-                + vy_ij * (a_e * tA2_i + b_e * tA2_j)
-                + vz_ij * (a_e * tA3_i + b_e * tA3_j),
-                0.0,
-            ),
-            1, keepdims=True,
+        a_e = w_i * (2.0 * mj_pro_i + vmi)
+        b_e = visc * mjw
+        energy = energy + jnp.where(
+            mm,
+            vx_ij * (a_e * tA1_i + b_e * tA1_j)
+            + vy_ij * (a_e * tA2_i + b_e * tA2_j)
+            + vz_ij * (a_e * tA3_i + b_e * tA3_j),
+            0.0,
         )
         return momx, momy, momz, energy, maxvs
 
     def finalize(i_fields, accs, nc):
         hi = i_fields[3]
-        ci = i_fields[7]
+        ci = i_fields[9]
         momx, momy, momz, energy, maxvs = accs
-        du = -K * 0.5 * energy
-        v = jnp.where(maxvs > 0.0, maxvs, ci)
+        red = lambda a: jnp.sum(a, axis=1, keepdims=True)
+        du = -K * 0.5 * red(energy)
+        mv = jnp.max(maxvs, axis=1, keepdims=True)
+        v = jnp.where(mv > 0.0, mv, ci)
         dt_i = k_cour * hi / v
-        return (K * momx, K * momy, K * momz, du, dt_i)
+        return (K * red(momx), K * red(momy), K * red(momz), du, dt_i)
 
     engine = group_pair_engine(
-        pair_body, finalize, num_i=17, num_j=17, num_acc=5, cfg=cfg,
+        pair_body, finalize, num_i=18, num_j=17, num_acc=5, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret,
     )
+    inv_h2 = 1.0 / (h * h)
+    inv_h3 = inv_h2 / h
     i_fields = _prep_i(
-        x, y, z, h, (vx, vy, vz, c, rho, p, m, c11, c12, c13, c22, c23, c33)
+        x, y, z, h,
+        (inv_h2, inv_h3, vx, vy, vz, c, p / (rho * rho), m / rho,
+         c11, c12, c13, c22, c23, c33),
     )
     jp = pack_j_fields(
-        (x, y, z, h, vx, vy, vz, c, rho, p, m, c11, c12, c13, c22, c23, c33),
+        (x, y, z, inv_h2, vx, vy, vz, c, m, m / (rho * h * h * h), p / rho,
+         c11, c12, c13, c22, c23, c33),
         cfg.cap,
     )
     ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp)
