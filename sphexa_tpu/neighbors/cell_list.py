@@ -56,10 +56,23 @@ class NeighborConfig:
     curve: str = "hilbert"
     group: int = 64  # particles per target group (TravConfig targetSize)
     window: int = 4  # cells per dimension of the group candidate block
+    # pallas engine: merge SFC-adjacent candidate cells into one streamed
+    # run of at most run_cap slots, bridging key-space gaps up to ``gap``
+    # particles (gap particles are legitimate extra candidates — masked by
+    # the distance test, or genuine neighbors counted once). 0 disables.
+    run_cap: int = 0
+    gap: int = 0
 
     @property
     def num_candidates(self) -> int:
         return self.window**3 * self.cap
+
+    @property
+    def dma_cap(self) -> int:
+        """Largest candidate span one kernel DMA must cover (cells when
+        merging is off, merged runs when on). SINGLE source of truth for
+        the engine's transfer shape and the packed-buffer tail pad."""
+        return max(self.cap, self.run_cap)
 
 
 def choose_grid_level(box_lengths, h_max: float) -> int:

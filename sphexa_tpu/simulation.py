@@ -52,10 +52,21 @@ def make_propagator_config(
     keep_accels: bool = False,
     keep_fields: bool = False,
     backend: str = "auto",
+    cell_target: int = 128,
+    run_cap: int = 1536,
+    gap: int = 384,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
-    the driver entry points)."""
+    the driver entry points).
+
+    ``cell_target`` picks the grid level by mean cell occupancy;
+    ``run_cap``/``gap`` control the pallas engine's merged-run streaming
+    (cell_list.NeighborConfig). Defaults tuned on v5e (scripts/
+    sweep_engine.py): ~128-per-cell grids beat finer levels (fragmented
+    short runs waste 128-lane chunks), and aggressive run merging cuts
+    the per-group DMA count ~3x.
+    """
     if backend == "auto":
         # fused pallas kernels on TPU, portable gather path elsewhere
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
@@ -64,10 +75,12 @@ def make_propagator_config(
     lengths = np.asarray(box.lengths)
     level = choose_grid_level(lengths, h_max)
     # group-window search covers the 2h radius at ANY level, so the level
-    # is free to target cell occupancy instead: ~128+ particles per cell
-    # keeps the per-cell overhead (DMA issue latency, range lookups)
-    # amortized — deep grids explode the window cell count
-    level_occ = max(1, round(np.log2(max(state.n / 128.0, 1.0)) / 3.0))
+    # is free to target cell occupancy instead; below ~cell_target
+    # particles per cell the extra window cells stop paying for the
+    # tighter candidate volume
+    level_occ = max(
+        1, round(np.log2(max(state.n / float(cell_target), 1.0)) / 3.0)
+    )
     level = min(level, level_occ)
 
     # host-side sizing pass: one device->host transfer of the coordinates,
@@ -85,7 +98,7 @@ def make_propagator_config(
     cap = pad_cap(native.max_cell_occupancy(keys[order], level))
     if min_cap > 0:
         cap = max(cap, pad_cap(min_cap))  # quantized so retry caps cache
-    group = 128  # must match the pallas engine's GROUP
+    group = 64  # targets per engine group (v5e sweep optimum)
     ncell = 1 << level
     ext = native.group_extents(xa, ya, za, order, group)
     # 10% radius slack absorbs drift between reconfigurations; a whole
@@ -99,6 +112,7 @@ def make_propagator_config(
     nbr = NeighborConfig(
         level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block,
         curve=curve, group=group, window=window,
+        run_cap=run_cap, gap=gap,
     )
     return PropagatorConfig(
         const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean,
@@ -131,6 +145,7 @@ class Simulation:
         turb_settings: Optional[Dict] = None,
         cooling_cfg=None,
         chem=None,
+        check_every: int = 1,
     ):
         self.state = state
         self.box = box
@@ -200,6 +215,17 @@ class Simulation:
             if self.chem is None:
                 self.chem = ChemistryData.ionized(state.n)
         self.iteration = 0
+        # deferred cap-checking (check_every > 1): the happy path launches
+        # steps without any device->host sync; diagnostics of the last
+        # ``check_every`` steps are fetched in ONE batched transfer at the
+        # check boundary. JAX arrays are immutable, so the rollback point
+        # costs one pinned state: we keep the window-start pytree refs
+        # alive and replay the window if a deferred check finds an
+        # overflow.
+        self.check_every = max(1, check_every)
+        self._pending = []  # per-step diagnostics of the open window
+        self._window_prior = None  # sim state refs at the window start
+        self._last_diag: Dict[str, float] = {"reconfigured": 0.0}
         self._cfg: Optional[PropagatorConfig] = None
         self._gtree = None
         self._configure()
@@ -274,77 +300,180 @@ class Simulation:
         return 2.0 * h_max <= cell_edge
 
     # -- main loop ----------------------------------------------------------
-    def step(self) -> Dict[str, float]:
-        """Advance one step; a step whose own diagnostics reveal a cell-cap
-        overflow (truncated neighbor candidates) is discarded and re-run
-        under a freshly sized config — overflow must never corrupt state."""
+    def _launch(self):
+        """Dispatch one jitted step on the current state (no host sync).
+        Returns (new_state, new_box, diagnostics, new_turb, new_chem)."""
         step_fn = _PROPAGATORS[self.prop_name]
-        reconfigured = False
-        grav_margin = 1.5
-        for _attempt in range(3):
-            new_turb, new_chem = None, None
-            if self.prop_name == "turb-ve":
-                new_state, new_box, diagnostics, new_turb = step_fn(
-                    self.state, self.box, self._cfg, self._gtree,
-                    self.turb_state, self.turb_cfg,
-                )
-            elif self.prop_name == "std-cooling":
-                new_state, new_box, diagnostics, new_chem = step_fn(
-                    self.state, self.box, self._cfg, self._gtree,
-                    self.chem, self.cooling_cfg,
-                )
-            else:
-                new_state, new_box, diagnostics = step_fn(
-                    self.state, self.box, self._cfg, self._gtree
-                )
-            # ONE batched device->host transfer for all scalar diagnostics
-            # (separate float()/int() conversions each pay a full round
-            # trip, which dominates on remote-attached TPUs)
-            scalars = {
-                k: v for k, v in diagnostics.items() if getattr(v, "ndim", 0) == 0
-            }
-            fetched = jax.device_get(scalars)
-            diagnostics = {**diagnostics, **fetched}
-            occ = int(diagnostics["occupancy"])
-            nbr_over = occ > self._cfg.nbr.cap
-            grav_over = self._gravity_overflowed(diagnostics)
-            if not nbr_over and not grav_over:
-                break
-            grav_margin *= 1.5 if grav_over else 1.0
-            # occ == cap+1 is the window-blowout SENTINEL, not a real
-            # occupancy — feeding it back as min_cap would ratchet the cap
-            # (and force a fresh compile) on every blowout; a plain
-            # re-estimate resizes the window instead
-            window_blown = occ == self._cfg.nbr.cap + 1
-            self._configure(
-                min_cap=0 if window_blown else occ, grav_margin=grav_margin
+        new_turb, new_chem = None, None
+        if self.prop_name == "turb-ve":
+            new_state, new_box, diagnostics, new_turb = step_fn(
+                self.state, self.box, self._cfg, self._gtree,
+                self.turb_state, self.turb_cfg,
             )
-            reconfigured = True
+        elif self.prop_name == "std-cooling":
+            new_state, new_box, diagnostics, new_chem = step_fn(
+                self.state, self.box, self._cfg, self._gtree,
+                self.chem, self.cooling_cfg,
+            )
         else:
-            raise RuntimeError("neighbor/gravity caps failed to converge in 3 attempts")
+            new_state, new_box, diagnostics = step_fn(
+                self.state, self.box, self._cfg, self._gtree
+            )
+        return new_state, new_box, diagnostics, new_turb, new_chem
+
+    def _apply(self, out):
+        new_state, new_box, _, new_turb, new_chem = out
         self.state = new_state
         self.box = new_box
         if new_turb is not None:
             self.turb_state = new_turb
         if new_chem is not None:
             self.chem = new_chem
+
+    @staticmethod
+    def _scalar_view(diagnostics) -> Dict:
+        return {
+            k: v for k, v in diagnostics.items() if getattr(v, "ndim", 0) == 0
+        }
+
+    @classmethod
+    def _fetch_scalars(cls, diagnostics) -> Dict:
+        """ONE batched device->host transfer for all scalar diagnostics
+        (separate float()/int() conversions each pay a full round trip,
+        which dominates on remote-attached TPUs)."""
+        return jax.device_get(cls._scalar_view(diagnostics))
+
+    def _overflowed(self, diagnostics) -> bool:
+        return (
+            int(diagnostics["occupancy"]) > self._cfg.nbr.cap
+            or self._gravity_overflowed(diagnostics)
+        )
+
+    def _reconfigure_after_overflow(self, diagnostics, grav_margin: float):
+        occ = int(diagnostics["occupancy"])
+        # occ == cap+1 is the window-blowout SENTINEL, not a real
+        # occupancy — feeding it back as min_cap would ratchet the cap
+        # (and force a fresh compile) on every blowout; a plain
+        # re-estimate resizes the window instead
+        window_blown = occ == self._cfg.nbr.cap + 1
+        nbr_over = occ > self._cfg.nbr.cap
+        self._configure(
+            min_cap=0 if window_blown or not nbr_over else occ,
+            grav_margin=grav_margin,
+        )
+
+    def _step_checked(self) -> Dict[str, float]:
+        """Advance one step synchronously; a step whose own diagnostics
+        reveal a cell-cap overflow (truncated neighbor candidates) is
+        discarded and re-run under a freshly sized config — overflow must
+        never corrupt state."""
+        reconfigured = False
+        grav_margin = 1.5
+        for _attempt in range(3):
+            out = self._launch()
+            diagnostics = {**out[2], **self._fetch_scalars(out[2])}
+            if not self._overflowed(diagnostics):
+                break
+            if self._gravity_overflowed(diagnostics):
+                grav_margin *= 1.5
+            self._reconfigure_after_overflow(diagnostics, grav_margin)
+            reconfigured = True
+        else:
+            raise RuntimeError(
+                "neighbor/gravity caps failed to converge in 3 attempts"
+            )
+        self._apply(out)
         self.iteration += 1
         if not self._config_still_valid(diagnostics):
             self._configure()
             reconfigured = True
-        out = {
+        result = {
             k: np.asarray(v) if getattr(v, "ndim", 0) else float(v)
             for k, v in diagnostics.items()
         }
-        out["reconfigured"] = float(reconfigured)
-        return out
+        result["reconfigured"] = float(reconfigured)
+        self._last_diag = result
+        return result
+
+    def step(self) -> Dict[str, float]:
+        """Advance one step.
+
+        With ``check_every == 1`` (default) the step is checked
+        synchronously. With ``check_every > 1`` steps are launched with NO
+        device->host sync on the happy path; every ``check_every`` steps
+        the accumulated diagnostics are fetched in one transfer and, if an
+        overflow is found, the simulation rolls back to the last verified
+        state and replays the lost steps under a fresh config (the same
+        discard-and-retry semantics, checked late). Diagnostics returned
+        between check boundaries are the last verified ones, marked
+        ``{"deferred": 1.0}``.
+        """
+        if self.check_every <= 1:
+            return self._step_checked()
+        if not self._pending:
+            # only the WINDOW-START state is pinned for rollback (one
+            # extra state, not check_every of them — 68 MB/state at 100^3)
+            self._window_prior = (self.state, self.box, self.turb_state,
+                                  self.chem, self.iteration)
+        out = self._launch()
+        self._apply(out)
+        self.iteration += 1
+        self._pending.append(out[2])
+        if len(self._pending) >= self.check_every:
+            return self.flush()
+        return {**self._last_diag, "deferred": 1.0}
+
+    def flush(self) -> Dict[str, float]:
+        """Drain the deferred-check queue: one batched fetch of every
+        pending step's scalar diagnostics; if any step overflowed, roll
+        back to the window-start state and replay the whole window through
+        the synchronous checked path."""
+        if not self._pending:
+            return self._last_diag
+        pending, self._pending = self._pending, []
+        prior, self._window_prior = self._window_prior, None
+        fetched = jax.device_get([self._scalar_view(d) for d in pending])
+        bad = next(
+            (i for i, scal in enumerate(fetched) if self._overflowed(scal)),
+            None,
+        )
+        if bad is None:
+            diagnostics = {**pending[-1], **fetched[-1]}
+            result = {
+                k: np.asarray(v) if getattr(v, "ndim", 0) else float(v)
+                for k, v in diagnostics.items()
+            }
+            result["reconfigured"] = 0.0
+            self._last_diag = result
+            if not self._config_still_valid(fetched[-1]):
+                self._configure()
+                self._last_diag["reconfigured"] = 1.0
+            return self._last_diag
+        # roll back to the window start and replay every window step
+        diag_bad = fetched[bad]
+        (self.state, self.box, self.turb_state, self.chem,
+         self.iteration) = prior
+        grav_margin = 1.5 * (1.5 if self._gravity_overflowed(diag_bad) else 1.0)
+        self._reconfigure_after_overflow(diag_bad, grav_margin)
+        for _ in range(len(pending)):
+            result = self._step_checked()
+        result["reconfigured"] = 1.0
+        self._last_diag = result
+        return result
 
     def run(self, num_steps: int, log_every: int = 0, printer=print):
         for _ in range(num_steps):
             d = self.step()
             if log_every and self.iteration % log_every == 0:
-                printer(
-                    f"it {self.iteration:5d}  t={float(self.state.ttot):.6g}  "
-                    f"dt={d['dt']:.4g}  nc~{d['nc_mean']:.1f}  rho_max={d['rho_max']:.4g}"
-                )
+                if d.get("deferred"):
+                    printer(f"it {self.iteration:5d}  (deferred check)")
+                else:
+                    printer(
+                        f"it {self.iteration:5d}  t={float(self.state.ttot):.6g}  "
+                        f"dt={d['dt']:.4g}  nc~{d['nc_mean']:.1f}  "
+                        f"rho_max={d['rho_max']:.4g}"
+                    )
+        # the final partial window must be verified before the state is
+        # handed back — overflow must never corrupt state
+        self.flush()
         return self.state

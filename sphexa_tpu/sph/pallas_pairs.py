@@ -50,7 +50,7 @@ from sphexa_tpu.sfc.hilbert import hilbert_encode
 from sphexa_tpu.sfc.morton import morton_encode
 from sphexa_tpu.sph.kernels import sinc_poly_coeffs, sinc_poly_eval
 
-GROUP = 128  # targets per group: one f32 lane row
+GROUP = 128  # default targets per group (NeighborConfig.group overrides)
 
 
 class PairGeom(NamedTuple):
@@ -118,7 +118,7 @@ def group_cell_ranges(
     edge = box.lengths / ncell
     periodic = box.periodic_mask
 
-    g = GROUP
+    g = cfg.group
     num_groups = -(-n // g)
     pad = num_groups * g - n
     gather_pad = lambda a: jnp.concatenate([a, jnp.broadcast_to(a[-1:], (pad,))]) if pad else a
@@ -208,16 +208,25 @@ def group_cell_ranges(
         img = jnp.floor_divide(cells, ncell).astype(jnp.float32)  # (NG, W3, 3)
         shifts = img * box.lengths[None, None, :]
 
-    # compact survivors to the front (stable: preserves SFC cell order)
-    order = jnp.argsort(jnp.logical_not(keep), axis=1, stable=True)
-    take = lambda a: jnp.take_along_axis(a, order, axis=1)
-    starts_c = take(start)
-    keep_c = take(keep)
-    lens_c = jnp.where(keep_c, take(lens), 0)
-    # dead slots DMA row 0 harmlessly (len 0 masks every pair)
-    starts_c = jnp.where(keep_c, starts_c, 0)
-    sh = [jnp.where(keep_c, take(shifts[..., d]), 0.0) for d in range(3)]
-    ncells = jnp.sum(keep, axis=1).astype(jnp.int32)
+    if cfg.run_cap > 0:
+        # merge SFC-adjacent survivors into long streamed runs (fewer,
+        # fuller chunks; see _merge_runs)
+        starts_c, lens_c, sh, ncells = _merge_runs(
+            start, lens, keep, shifts, cfg.run_cap, cfg.gap
+        )
+    else:
+        # compact survivors to the front (stable: preserves SFC cell order)
+        _, kc_i, starts_c, lens_s, shx_c, shy_c, shz_c = jax.lax.sort(
+            ((~keep).astype(jnp.int32), keep.astype(jnp.int32), start, lens,
+             shifts[..., 0], shifts[..., 1], shifts[..., 2]),
+            num_keys=1, dimension=1, is_stable=True,
+        )
+        keep_c = kc_i.astype(bool)
+        lens_c = jnp.where(keep_c, lens_s, 0)
+        # dead slots DMA row 0 harmlessly (len 0 masks every pair)
+        starts_c = jnp.where(keep_c, starts_c, 0)
+        sh = [jnp.where(keep_c, a, 0.0) for a in (shx_c, shy_c, shz_c)]
+        ncells = jnp.sum(keep, axis=1).astype(jnp.int32)
 
     # cap overflow only matters for cells the kernel will visit: a culled
     # cell's clipped length truncates nothing
@@ -236,6 +245,98 @@ def group_cell_ranges(
         shift_x=sh[0], shift_y=sh[1], shift_z=sh[2],
         ncells=ncells, occupancy=occupancy, boxl=boxl.astype(jnp.float32),
     )
+
+
+def _merge_runs(start, lens, keep, shifts, run_cap: int, gap: int):
+    """Merge kept cells into contiguous streamed RUNS per group.
+
+    The SFC sort makes spatially adjacent cells often key-adjacent, so
+    their sorted-array ranges concatenate; merging them (and bridging
+    key gaps of up to ``gap`` slots) turns many short cell DMAs with
+    mostly-padded 128-lane chunks into few long runs with full chunks.
+    Gap slots are pure bounded waste-work, never spurious physics: a gap
+    particle belongs to a culled or out-of-window cell, and any such
+    cell's AABB — at the single image position the window block can
+    contain (window < ncell) — lies outside the group's inflated search
+    bbox, so the particle cannot pass the distance mask under the run's
+    shift; in fold mode (window >= ncell) every non-empty cell is kept,
+    so gaps contain no particles at all. Runs never span different box
+    images and are clipped to ``run_cap`` slots (the engine's static DMA
+    window, NeighborConfig.dma_cap).
+
+    Returns (starts, lens, [shift_x, shift_y, shift_z], nruns), shaped
+    like the unmerged compaction.
+    """
+    ng, w3 = start.shape
+    INF = jnp.int32(2**30)
+    # variadic sort carries every payload through the sorting network —
+    # argsort + take_along_axis would pay ~6 full-array gathers instead
+    _, s, l, ki, shx, shy, shz = jax.lax.sort(
+        (jnp.where(keep, start, INF), start, lens, keep.astype(jnp.int32),
+         shifts[..., 0], shifts[..., 1], shifts[..., 2]),
+        num_keys=1, dimension=1,
+    )
+    k = ki.astype(bool)
+    # unkept tail entries must not extend any run's end
+    end_eff = jnp.where(k, s + l, -1)
+
+    # forward scan: mark run heads (kept cells that cannot join the
+    # running span: image mismatch, gap too wide, or span over run_cap)
+    def fstep(carry, inp):
+        run_start, prev_end, px, py, pz = carry
+        s_w, l_w, k_w, sx, sy, sz = inp
+        same = (sx == px) & (sy == py) & (sz == pz)
+        join = (
+            k_w & same
+            & (s_w - prev_end <= gap)
+            & (s_w + l_w - run_start <= run_cap)
+        )
+        new_start = jnp.where(join, run_start, s_w)
+        carry = (
+            jnp.where(k_w, new_start, run_start),
+            jnp.where(k_w, s_w + l_w, prev_end),
+            jnp.where(k_w, sx, px),
+            jnp.where(k_w, sy, py),
+            jnp.where(k_w, sz, pz),
+        )
+        return carry, k_w & ~join
+
+    init = (
+        jnp.zeros(ng, jnp.int32),
+        jnp.full((ng,), -INF, jnp.int32),
+        jnp.zeros(ng, jnp.float32),
+        jnp.zeros(ng, jnp.float32),
+        jnp.zeros(ng, jnp.float32),
+    )
+    xs = tuple(a.T for a in (s, l, k, shx, shy, shz))
+    _, is_head_t = jax.lax.scan(fstep, init, xs)
+    is_head = is_head_t.T  # (ng, w3)
+
+    # reverse scan: each run head's END = max cell end before the next head
+    head_next = jnp.concatenate(
+        [is_head[:, 1:], jnp.ones((ng, 1), bool)], axis=1
+    )
+    def rstep(carry, inp):
+        e_w, hn_w = inp
+        r = jnp.maximum(e_w, jnp.where(hn_w, jnp.int32(-1), carry))
+        return r, r
+
+    xs_r = (end_eff[:, ::-1].T, head_next[:, ::-1].T)
+    _, r_t = jax.lax.scan(rstep, jnp.full((ng,), -1, jnp.int32), xs_r)
+    run_end = r_t.T[:, ::-1]
+
+    # compact heads to the front (stable: preserves key order)
+    _, hk_i, hs_r, hlen, cshx, cshy, cshz = jax.lax.sort(
+        ((~is_head).astype(jnp.int32), is_head.astype(jnp.int32), s,
+         run_end - s, shx, shy, shz),
+        num_keys=1, dimension=1, is_stable=True,
+    )
+    hk = hk_i.astype(bool)
+    hs = jnp.where(hk, hs_r, 0)
+    hl = jnp.where(hk, hlen, 0)
+    sh = [jnp.where(hk, a, 0.0) for a in (cshx, cshy, cshz)]
+    nruns = jnp.sum(is_head, axis=1).astype(jnp.int32)
+    return hs, hl, sh, nruns
 
 
 def _round_up(v: int, q: int) -> int:
@@ -294,7 +395,7 @@ def group_pair_engine(
       (outs (NG, G) x num_out, nc (NG, G)).
     """
     w3 = cfg.window**3
-    R = _dma_rows(cfg.cap)
+    R = _dma_rows(cfg.dma_cap)
     nf_pad = _round_up(num_j, 8)
 
     def kernel(*refs):
@@ -306,7 +407,7 @@ def group_pair_engine(
         buf, sems = refs[-1]  # unpacked below
 
         gi = pl.program_id(0)
-        G = GROUP
+        G = cfg.group
 
         nc_g = ncells[0, 0, 0]
 
@@ -382,8 +483,8 @@ def group_pair_engine(
         nc_acc = jnp.sum(nc_acc, axis=1, keepdims=True)
         outs = finalize(i_fields, accs, nc_acc)
         for r, o in zip(out_refs, outs):
-            r[0, 0] = o.reshape(GROUP)
-        nc_ref[0, 0] = nc_acc.reshape(GROUP)
+            r[0, 0] = o.reshape(G)
+        nc_ref[0, 0] = nc_acc.reshape(G)
 
     def scalar_kernel(*refs):
         # scratch unpack shim: keep kernel() readable
@@ -399,12 +500,13 @@ def group_pair_engine(
         shz = smem3(ranges.shift_z)
         ncells = ranges.ncells.reshape(num_groups, 1, 1)
         boxl = ranges.boxl.reshape(1, 1, 3)
-        i_fields = [a.reshape(num_groups, 1, GROUP) for a in i_fields]
+        G = cfg.group
+        i_fields = [a.reshape(num_groups, 1, G) for a in i_fields]
         num_out_arrays = len(
             finalize(
-                [jnp.zeros((GROUP, 1))] * num_i,
-                tuple(jnp.zeros((GROUP, 1)) for _ in range(num_acc)),
-                jnp.zeros((GROUP, 1), jnp.int32),
+                [jnp.zeros((G, 1))] * num_i,
+                tuple(jnp.zeros((G, 1)) for _ in range(num_acc)),
+                jnp.zeros((G, 1), jnp.int32),
             )
         )
         smem_spec = lambda shape: pl.BlockSpec(
@@ -424,24 +526,24 @@ def group_pair_engine(
                              memory_space=pltpu.SMEM),  # boxl
             ]
             + [
-                pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))
+                pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
                 for _ in range(num_i)
             ]
             + [pl.BlockSpec(memory_space=pl.ANY)],
             out_specs=[
-                pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))
+                pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
                 for _ in range(num_out_arrays)
             ]
-            + [pl.BlockSpec((1, 1, GROUP), lambda g: (g, 0, 0))],
+            + [pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))],
             scratch_shapes=[
                 pltpu.VMEM((2, R, nf_pad, 128), jnp.float32),
                 pltpu.SemaphoreType.DMA((2,)),
             ],
         )
         out_shape = [
-            jax.ShapeDtypeStruct((num_groups, 1, GROUP), jnp.float32)
+            jax.ShapeDtypeStruct((num_groups, 1, G), jnp.float32)
             for _ in range(num_out_arrays)
-        ] + [jax.ShapeDtypeStruct((num_groups, 1, GROUP), jnp.int32)]
+        ] + [jax.ShapeDtypeStruct((num_groups, 1, G), jnp.int32)]
         outs = pl.pallas_call(
             scalar_kernel,
             grid_spec=grid_spec,
@@ -453,16 +555,16 @@ def group_pair_engine(
     return call
 
 
-def _prep_i(x, y, z, h, extra_i):
-    """Block the target-side fields (NG, GROUP); tail groups re-read the
+def _prep_i(x, y, z, h, extra_i, group: int = GROUP):
+    """Block the target-side fields (NG, group); tail groups re-read the
     last particle (masked out by the self/index tests)."""
     n = x.shape[0]
-    num_groups = -(-n // GROUP)
-    pad_i = num_groups * GROUP - n
+    num_groups = -(-n // group)
+    pad_i = num_groups * group - n
 
     def block_i(a):
         a = jnp.concatenate([a, jnp.broadcast_to(a[-1:], (pad_i,))]) if pad_i else a
-        return a.reshape(num_groups, GROUP)
+        return a.reshape(num_groups, group)
 
     return [block_i(a) for a in (x, y, z, h, *extra_i)]
 
@@ -506,8 +608,8 @@ def pallas_density(
         pair_body, finalize, num_i=6, num_j=4, num_acc=1, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret,
     )
-    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m))
-    jp = pack_j_fields((x, y, z, m), cfg.cap)
+    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m), cfg.group)
+    jp = pack_j_fields((x, y, z, m), cfg.dma_cap)
     rho, nc = engine(ranges, i_fields, jp)
     return rho.reshape(-1)[:n], nc.reshape(-1)[:n], ranges.occupancy
 
@@ -568,8 +670,8 @@ def pallas_iad(
         pair_body, finalize, num_i=5, num_j=4, num_acc=6, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret,
     )
-    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h),))
-    jp = pack_j_fields((x, y, z, vol), cfg.cap)
+    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h),), cfg.group)
+    jp = pack_j_fields((x, y, z, vol), cfg.dma_cap)
     *cs, _nc = engine(ranges, i_fields, jp)
     return tuple(c.reshape(-1)[:n] for c in cs), ranges.occupancy
 
@@ -675,11 +777,12 @@ def pallas_momentum_energy_std(
         x, y, z, h,
         (inv_h2, inv_h3, vx, vy, vz, c, p / (rho * rho), m / rho,
          c11, c12, c13, c22, c23, c33),
+        cfg.group,
     )
     jp = pack_j_fields(
         (x, y, z, inv_h2, vx, vy, vz, c, m, m / (rho * h * h * h), p / rho,
          c11, c12, c13, c22, c23, c33),
-        cfg.cap,
+        cfg.dma_cap,
     )
     ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp)
     f = lambda a: a.reshape(-1)[:n]
