@@ -287,6 +287,15 @@ def step_hydro_std_cooling(
     return new_state, box, diag, chem
 
 
+def _split_dvout(dvout, av_clean: bool):
+    """Unpack the divv/curlv op's outputs (shared by both VE backends)."""
+    if av_clean:
+        divv, curlv, *gradv = dvout
+        return divv, curlv, tuple(gradv)
+    divv, curlv = dvout
+    return divv, curlv, None
+
+
 def _ve_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree],
@@ -303,42 +312,76 @@ def _ve_forces(
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
     vx, vy, vz = state.vx, state.vy, state.vz
 
-    nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
+    if cfg.backend == "pallas":
+        # fused search+op TPU engine for the full VE sequence — the
+        # reference's flagship propagator (ve_hydro.hpp:131-208) on the
+        # fast path, sharing one cell-range prologue across all six ops
+        from sphexa_tpu.sph import pallas_pairs as pp
 
-    xm = hydro_ve.compute_xmass(x, y, z, h, m, nidx, nmask, box, const, cfg.block)
-    kx, gradh = hydro_ve.compute_ve_def_gradh(
-        x, y, z, h, m, xm, nidx, nmask, box, const, cfg.block
-    )
-    prho, c, rho, p = hydro_ve.compute_eos_ve(state.temp, m, kx, xm, gradh, const)
+        ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
+        occ = ranges.occupancy
+        xm, nc, _ = pp.pallas_xmass(
+            x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges
+        )
+        (kx, gradh), _ = pp.pallas_ve_def_gradh(
+            x, y, z, h, m, xm, keys, box, const, cfg.nbr, ranges=ranges
+        )
+        prho, c, rho, p = hydro_ve.compute_eos_ve(
+            state.temp, m, kx, xm, gradh, const
+        )
+        (c11, c12, c13, c22, c23, c33), _ = pp.pallas_iad(
+            x, y, z, h, xm / kx, keys, box, const, cfg.nbr, ranges=ranges
+        )
+        dvout, _ = pp.pallas_iad_divv_curlv(
+            x, y, z, vx, vy, vz, h, kx, xm,
+            c11, c12, c13, c22, c23, c33,
+            keys, box, const, cfg.nbr, ranges=ranges,
+            with_gradv=cfg.av_clean,
+        )
+        divv, curlv, gradv = _split_dvout(dvout, cfg.av_clean)
+        dt_rho = rho_timestep(divv, const)
 
-    c11, c12, c13, c22, c23, c33 = hydro_std.compute_iad(
-        x, y, z, h, xm / kx, nidx, nmask, box, const, cfg.block
-    )
-    dvout = hydro_ve.compute_iad_divv_curlv(
-        x, y, z, vx, vy, vz, h, kx, xm,
-        c11, c12, c13, c22, c23, c33,
-        nidx, nmask, box, const, cfg.block, with_gradv=cfg.av_clean,
-    )
-    if cfg.av_clean:
-        divv, curlv, *gradv = dvout
-        gradv = tuple(gradv)
+        alpha, _ = pp.pallas_av_switches(
+            x, y, z, vx, vy, vz, h, c, kx, xm, divv, state.alpha,
+            c11, c12, c13, c22, c23, c33,
+            keys, box, state.min_dt, const, cfg.nbr, ranges=ranges,
+        )
+        ax, ay, az, du, dt_courant, _ = pp.pallas_momentum_energy_ve(
+            x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
+            c11, c12, c13, c22, c23, c33,
+            keys, box, const, cfg.nbr, nc=nc, gradv=gradv, ranges=ranges,
+        )
     else:
-        divv, curlv = dvout
-        gradv = None
+        nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
 
-    dt_rho = rho_timestep(divv, const)
+        xm = hydro_ve.compute_xmass(x, y, z, h, m, nidx, nmask, box, const, cfg.block)
+        kx, gradh = hydro_ve.compute_ve_def_gradh(
+            x, y, z, h, m, xm, nidx, nmask, box, const, cfg.block
+        )
+        prho, c, rho, p = hydro_ve.compute_eos_ve(state.temp, m, kx, xm, gradh, const)
 
-    alpha = hydro_ve.compute_av_switches(
-        x, y, z, vx, vy, vz, h, c, kx, xm, divv, state.alpha,
-        c11, c12, c13, c22, c23, c33,
-        nidx, nmask, box, state.min_dt, const, cfg.block,
-    )
+        c11, c12, c13, c22, c23, c33 = hydro_std.compute_iad(
+            x, y, z, h, xm / kx, nidx, nmask, box, const, cfg.block
+        )
+        dvout = hydro_ve.compute_iad_divv_curlv(
+            x, y, z, vx, vy, vz, h, kx, xm,
+            c11, c12, c13, c22, c23, c33,
+            nidx, nmask, box, const, cfg.block, with_gradv=cfg.av_clean,
+        )
+        divv, curlv, gradv = _split_dvout(dvout, cfg.av_clean)
+        dt_rho = rho_timestep(divv, const)
 
-    ax, ay, az, du, dt_courant = hydro_ve.compute_momentum_energy_ve(
-        x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
-        c11, c12, c13, c22, c23, c33,
-        nidx, nmask, nc, box, const, cfg.block, gradv=gradv,
-    )
+        alpha = hydro_ve.compute_av_switches(
+            x, y, z, vx, vy, vz, h, c, kx, xm, divv, state.alpha,
+            c11, c12, c13, c22, c23, c33,
+            nidx, nmask, box, state.min_dt, const, cfg.block,
+        )
+
+        ax, ay, az, du, dt_courant = hydro_ve.compute_momentum_energy_ve(
+            x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
+            c11, c12, c13, c22, c23, c33,
+            nidx, nmask, nc, box, const, cfg.block, gradv=gradv,
+        )
 
     extra_dts, gdiag = (), None
     if cfg.gravity is not None:

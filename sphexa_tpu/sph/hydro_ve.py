@@ -16,8 +16,8 @@ import jax.numpy as jnp
 from sphexa_tpu.sfc.box import Box
 from sphexa_tpu.sph.kernels import (
     artificial_viscosity,
-    sinc_kernel,
-    sinc_kernel_derivative,
+    sinc_dterh_u,
+    sinc_kernel_u,
     ts_k_courant,
 )
 from sphexa_tpu.sph.pairs import iad_project, mmax, msum, pair_geometry
@@ -32,7 +32,7 @@ def compute_xmass(x, y, z, h, m, nidx, nmask, box: Box, const: SimConstants, blo
 
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
-        w = sinc_kernel(g.v1, const.sinc_index)
+        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
         rho0 = m[idx] + msum(g.mask, m[g.nj] * w)
         h_i = h[idx]
         return m[idx] / (rho0 * const.K / (h_i * h_i * h_i))
@@ -52,9 +52,8 @@ def compute_ve_def_gradh(
 
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
-        w = sinc_kernel(g.v1, const.sinc_index)
-        dw = sinc_kernel_derivative(g.v1, const.sinc_index)
-        dterh = -(3.0 * w + g.v1 * dw)
+        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
+        dterh = sinc_dterh_u(g.v1 * g.v1, const.sinc_index)
 
         xm_i = xm[idx]
         m_i = m[idx]
@@ -106,7 +105,7 @@ def compute_iad_divv_curlv(
 
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
-        w = sinc_kernel(g.v1, const.sinc_index)
+        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
 
         tA1, tA2, tA3 = iad_project(
             c11[idx][:, None], c12[idx][:, None], c13[idx][:, None],
@@ -158,7 +157,7 @@ def compute_av_switches(
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
         h_i = h[idx]
-        w = const.K / (h_i * h_i * h_i)[:, None] * sinc_kernel(g.v1, const.sinc_index)
+        w = const.K / (h_i * h_i * h_i)[:, None] * sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
 
         vx_ij = vx[idx][:, None] - vx[g.nj]
         vy_ij = vy[idx][:, None] - vy[g.nj]
@@ -242,9 +241,9 @@ def compute_momentum_energy_ve(
         h_j = h[g.nj]
         hi3 = h_i * h_i * h_i
         hj3 = h_j * h_j * h_j
-        w_i = sinc_kernel(g.v1, const.sinc_index) / hi3
+        w_i = sinc_kernel_u(g.v1 * g.v1, const.sinc_index) / hi3
         v2 = g.dist / h_j
-        w_j = sinc_kernel(v2, const.sinc_index) / hj3
+        w_j = sinc_kernel_u(v2 * v2, const.sinc_index) / hj3
 
         vx_ij = vx[idx][:, None] - vx[g.nj]
         vy_ij = vy[idx][:, None] - vy[g.nj]

@@ -68,6 +68,36 @@ def sinc_kernel_u(u, n: float = 6.0):
     return sinc_poly_eval(u, sinc_poly_coeffs(float(n)))
 
 
+@functools.lru_cache(maxsize=None)
+def sinc_dterh_coeffs(n: float, degree: int = 13) -> tuple:
+    """Coefficients of dterh(v) = -(3 W + v dW/dv) in s = v^2/2 - 1.
+
+    The h-derivative combination of ve_def_gradh_kern.hpp:58-66, derived
+    ANALYTICALLY from the W fit: with W = p(s), v dW/dv = 2(s+1) p'(s),
+    so dterh = -(3 p + 2(s+1) p') — exactly consistent with the W the
+    pair ops evaluate (f32 error ~2e-6, and dterh(0) = -3 by
+    construction)."""
+    c = sinc_poly_coeffs(n, degree)
+    d = []
+    for k in range(len(c)):
+        v = (3.0 + 2.0 * k) * c[k]
+        if k + 1 < len(c):
+            v += 2.0 * (k + 1) * c[k + 1]
+        d.append(-v)
+    return tuple(d)
+
+
+def sinc_dterh_u(u, n: float = 6.0):
+    """dterh = -(3 W + v dW/dv) from the SQUARED normalized distance
+    (no zero-floor: dterh is negative inside the support)."""
+    coeffs = sinc_dterh_coeffs(float(n))
+    s = jnp.clip(u * 0.5 - 1.0, -1.0, 1.0)
+    acc = jnp.full_like(s, coeffs[-1])
+    for c in coeffs[-2::-1]:
+        acc = acc * s + c
+    return acc
+
+
 def sinc_kernel(v, n: float = 6.0):
     """W_n(v) = sinc(pi/2 * v)^n on v in [0, 2]; 0 outside.
 

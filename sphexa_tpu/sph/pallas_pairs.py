@@ -48,7 +48,11 @@ from sphexa_tpu.neighbors.cell_list import NeighborConfig, _window_offsets
 from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sfc.hilbert import hilbert_encode
 from sphexa_tpu.sfc.morton import morton_encode
-from sphexa_tpu.sph.kernels import sinc_poly_coeffs, sinc_poly_eval
+from sphexa_tpu.sph.kernels import (
+    sinc_dterh_u,
+    sinc_poly_coeffs,
+    sinc_poly_eval,
+)
 
 GROUP = 128  # default targets per group (NeighborConfig.group overrides)
 
@@ -784,6 +788,427 @@ def pallas_momentum_energy_std(
          c11, c12, c13, c22, c23, c33),
         cfg.dma_cap,
     )
+    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp)
+    f = lambda a: a.reshape(-1)[:n]
+    return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy
+
+
+# ---------------------------------------------------------------------------
+# VE pipeline ops (sph/hydro_ve counterparts with the search fused in).
+# The reference's flagship propagator is VE (main/src/propagator/
+# ve_hydro.hpp:51); every op below mirrors its hydro_ve kernel
+# (xmass_kern.hpp, ve_def_gradh_kern.hpp, divv_curlv_kern.hpp,
+# av_switches_kern.hpp, momentum_energy_kern.hpp) with the same
+# precombined-ratio strategy as the std momentum op.
+# ---------------------------------------------------------------------------
+
+
+def pallas_xmass(
+    x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
+    ranges=None, interpret: bool = False,
+):
+    """Generalized volume element xm_i = m_i / rho0_i (xmass_kern.hpp:50-79)
+    + neighbor counts. rho0 is exactly the std kernel-summed density, so
+    this delegates to pallas_density. Returns (xm (n,), nc (n,), occ)."""
+    rho0, nc, occ = pallas_density(
+        x, y, z, h, m, sorted_keys, box, const, cfg,
+        ranges=ranges, interpret=interpret,
+    )
+    return m / rho0, nc, occ
+
+
+def pallas_ve_def_gradh(
+    x, y, z, h, m, xm, sorted_keys, box: Box, const, cfg: NeighborConfig,
+    ranges=None, interpret: bool = False,
+):
+    """VE normalization kx + grad-h correction (ve_def_gradh_kern.hpp:43-90)
+    with the search fused in. Returns ((kx, gradh), occupancy)."""
+    n = x.shape[0]
+    wc = sinc_poly_coeffs(float(const.sinc_index))
+    sinc_n = float(const.sinc_index)
+    K = float(const.K)
+
+    if ranges is None:
+        ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
+
+    def pair_body(geom, i_fields, j_fields, accs):
+        kxs, who, wro = accs
+        inv_h2 = i_fields[4]
+        mj = j_fields[3]
+        xmj = j_fields[4]
+        u = geom.d2 * inv_h2
+        w = _w_poly(u, wc)
+        dterh = sinc_dterh_u(u, sinc_n)
+        mm = geom.mask
+        kxs = kxs + jnp.where(mm, xmj * w, 0.0)
+        who = who + jnp.where(mm, xmj * dterh, 0.0)
+        wro = wro + jnp.where(mm, mj * dterh, 0.0)
+        return kxs, who, wro
+
+    def finalize(i_fields, accs, nc):
+        hi = i_fields[3]
+        mi = i_fields[5]
+        xmi = i_fields[6]
+        red = lambda a: jnp.sum(a, axis=1, keepdims=True)
+        h3inv = 1.0 / (hi * hi * hi)
+        kx = (xmi + red(accs[0])) * K * h3inv
+        whomega = (-3.0 * xmi + red(accs[1])) * K * h3inv / hi
+        wrho0 = (-3.0 * mi + red(accs[2])) * K * h3inv / hi
+        whomega = whomega * mi / xmi + (kx - K * xmi * h3inv) * wrho0
+        rho = kx * mi / xmi
+        dhdrho = -hi / (rho * 3.0)
+        gradh = 1.0 - dhdrho * whomega
+        return (kx, gradh)
+
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=7, num_j=5, num_acc=3, cfg=cfg,
+        fold=engine_fold(box, cfg), interpret=interpret,
+    )
+    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m, xm), cfg.group)
+    jp = pack_j_fields((x, y, z, m, xm), cfg.dma_cap)
+    kx, gradh, _nc = engine(ranges, i_fields, jp)
+    f = lambda a: a.reshape(-1)[:n]
+    return (f(kx), f(gradh)), ranges.occupancy
+
+
+def pallas_iad_divv_curlv(
+    x, y, z, vx, vy, vz, h, kx, xm,
+    c11, c12, c13, c22, c23, c33,
+    sorted_keys, box: Box, const, cfg: NeighborConfig,
+    ranges=None, with_gradv: bool = False, interpret: bool = False,
+):
+    """Velocity divergence/curl through the IAD gradient
+    (divv_curlv_kern.hpp:43-120), optionally the full symmetrized
+    velocity-gradient tensor for avClean. Returns (outs, occupancy) with
+    outs = (divv, curlv[, dv11..dv33])."""
+    n = x.shape[0]
+    wc = sinc_poly_coeffs(float(const.sinc_index))
+    K = float(const.K)
+
+    if ranges is None:
+        ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
+
+    def pair_body(geom, i_fields, j_fields, accs):
+        (xi, yi, zi, hi, inv_h2,
+         c11i, c12i, c13i, c22i, c23i, c33i, _knorm) = i_fields[:12]
+        (cx, cy, cz, xmj, vxj, vyj, vzj) = j_fields[:7]
+        vxi, vyi, vzi = i_fields[12], i_fields[13], i_fields[14]
+
+        # negated projection: the VE kernels use tA = -(C r) W
+        # (iad_project sign=-1, divv_curlv_kern.hpp)
+        w = -_w_poly(geom.d2 * inv_h2, wc)
+        tA1 = (c11i * geom.rx + c12i * geom.ry + c13i * geom.rz) * w
+        tA2 = (c12i * geom.rx + c22i * geom.ry + c23i * geom.rz) * w
+        tA3 = (c13i * geom.rx + c23i * geom.ry + c33i * geom.rz) * w
+        vx_ji = vxj - vxi
+        vy_ji = vyj - vyi
+        vz_ji = vzj - vzi
+        mm = geom.mask
+        mw = jnp.where(mm, xmj, 0.0)
+        if with_gradv:
+            dvx1, dvx2, dvx3, dvy1, dvy2, dvy3, dvz1, dvz2, dvz3 = accs
+            dvx1 = dvx1 + mw * vx_ji * tA1
+            dvx2 = dvx2 + mw * vx_ji * tA2
+            dvx3 = dvx3 + mw * vx_ji * tA3
+            dvy1 = dvy1 + mw * vy_ji * tA1
+            dvy2 = dvy2 + mw * vy_ji * tA2
+            dvy3 = dvy3 + mw * vy_ji * tA3
+            dvz1 = dvz1 + mw * vz_ji * tA1
+            dvz2 = dvz2 + mw * vz_ji * tA2
+            dvz3 = dvz3 + mw * vz_ji * tA3
+            return dvx1, dvx2, dvx3, dvy1, dvy2, dvy3, dvz1, dvz2, dvz3
+        adiv, acx, acy, acz = accs
+        adiv = adiv + mw * (vx_ji * tA1 + vy_ji * tA2 + vz_ji * tA3)
+        acx = acx + mw * (vz_ji * tA2 - vy_ji * tA3)
+        acy = acy + mw * (vx_ji * tA3 - vz_ji * tA1)
+        acz = acz + mw * (vy_ji * tA1 - vx_ji * tA2)
+        return adiv, acx, acy, acz
+
+    def finalize(i_fields, accs, nc):
+        knorm = i_fields[11]
+        red = lambda a: jnp.sum(a, axis=1, keepdims=True)
+        if with_gradv:
+            dvx1, dvx2, dvx3, dvy1, dvy2, dvy3, dvz1, dvz2, dvz3 = (
+                red(a) for a in accs
+            )
+            divv = knorm * (dvx1 + dvy2 + dvz3)
+            cx_ = dvz2 - dvy3
+            cy_ = dvx3 - dvz1
+            cz_ = dvy1 - dvx2
+            curlv = knorm * jnp.sqrt(cx_ * cx_ + cy_ * cy_ + cz_ * cz_)
+            return (
+                divv, curlv,
+                knorm * dvx1, knorm * (dvx2 + dvy1), knorm * (dvx3 + dvz1),
+                knorm * dvy2, knorm * (dvy3 + dvz2), knorm * dvz3,
+            )
+        adiv, acx, acy, acz = (red(a) for a in accs)
+        divv = knorm * adiv
+        curlv = knorm * jnp.sqrt(acx * acx + acy * acy + acz * acz)
+        return (divv, curlv)
+
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=15, num_j=7,
+        num_acc=9 if with_gradv else 4, cfg=cfg,
+        fold=engine_fold(box, cfg), interpret=interpret,
+    )
+    knorm = K / (h * h * h * kx)
+    i_fields = _prep_i(
+        x, y, z, h,
+        (1.0 / (h * h), c11, c12, c13, c22, c23, c33, knorm, vx, vy, vz),
+        cfg.group,
+    )
+    jp = pack_j_fields((x, y, z, xm, vx, vy, vz), cfg.dma_cap)
+    *outs, _nc = engine(ranges, i_fields, jp)
+    f = lambda a: a.reshape(-1)[:n]
+    return tuple(f(a) for a in outs), ranges.occupancy
+
+
+def pallas_av_switches(
+    x, y, z, vx, vy, vz, h, c, kx, xm, divv, alpha,
+    c11, c12, c13, c22, c23, c33,
+    sorted_keys, box: Box, dt, const, cfg: NeighborConfig,
+    ranges=None, interpret: bool = False,
+):
+    """Per-particle viscosity switch evolution (av_switches_kern.hpp:43-137)
+    with the search fused in. Returns (alpha_new (n,), occupancy)."""
+    n = x.shape[0]
+    wc = sinc_poly_coeffs(float(const.sinc_index))
+    K = float(const.K)
+    alphamax = float(const.alphamax)
+    alphamin = float(const.alphamin)
+    decay_c = float(const.decay_constant)
+
+    if ranges is None:
+        ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
+
+    def pair_body(geom, i_fields, j_fields, accs):
+        vs_max, gdx, gdy, gdz = accs
+        (xi, yi, zi, hi, inv_h2, kh3, ci, divvi,
+         c11i, c12i, c13i, c22i, c23i, c33i) = i_fields[:14]
+        vxi, vyi, vzi = i_fields[14], i_fields[15], i_fields[16]
+        (cx, cy, cz, cj, vxj, vyj, vzj, volj, divvj) = j_fields[:9]
+
+        # negated projection (iad_project sign=-1, av_switches_kern.hpp)
+        w = -_w_poly(geom.d2 * inv_h2, wc) * kh3
+        vx_ij = vxi - vxj
+        vy_ij = vyi - vyj
+        vz_ij = vzi - vzj
+        rv = geom.rx * vx_ij + geom.ry * vy_ij + geom.rz * vz_ij
+        inv_dist = jax.lax.rsqrt(geom.d2)
+        vsig = jnp.where(rv < 0.0, ci + cj - 3.0 * rv * inv_dist, 0.0)
+        vs_max = jnp.maximum(vs_max, jnp.where(geom.mask, vsig, 0.0))
+
+        tA1 = (c11i * geom.rx + c12i * geom.ry + c13i * geom.rz) * w
+        tA2 = (c12i * geom.rx + c22i * geom.ry + c23i * geom.rz) * w
+        tA3 = (c13i * geom.rx + c23i * geom.ry + c33i * geom.rz) * w
+        factor = jnp.where(geom.mask, volj * (divvi - divvj), 0.0)
+        gdx = gdx + factor * tA1
+        gdy = gdy + factor * tA2
+        gdz = gdz + factor * tA3
+        return vs_max, gdx, gdy, gdz
+
+    def finalize(i_fields, accs, nc):
+        hi = i_fields[3]
+        ci = i_fields[6]
+        divvi = i_fields[7]
+        alpha_i = i_fields[17]
+        dt_b = i_fields[18]
+        vs = jnp.max(accs[0], axis=1, keepdims=True)
+        red = lambda a: jnp.sum(a, axis=1, keepdims=True)
+        gdx, gdy, gdz = red(accs[1]), red(accs[2]), red(accs[3])
+        vijsignal = jnp.maximum(vs, 1e-40 * ci)
+        graddivv = jnp.sqrt(gdx * gdx + gdy * gdy + gdz * gdz)
+        a_const = hi * hi * graddivv
+        alphaloc = jnp.where(
+            divvi < 0.0,
+            alphamax * a_const
+            / (a_const + hi * jnp.abs(divvi) + 0.05 * ci),
+            0.0,
+        )
+        decay = hi / (decay_c * vijsignal)
+        target = jnp.maximum(alphaloc, alphamin)
+        alphadot = (target - alpha_i) / decay
+        alpha_decayed = alpha_i + alphadot * dt_b
+        return (jnp.where(alphaloc >= alpha_i, alphaloc, alpha_decayed),)
+
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=19, num_j=9, num_acc=4, cfg=cfg,
+        fold=engine_fold(box, cfg), interpret=interpret,
+    )
+    dt_b = jnp.broadcast_to(jnp.asarray(dt, jnp.float32), x.shape)
+    i_fields = _prep_i(
+        x, y, z, h,
+        (1.0 / (h * h), K / (h * h * h), c, divv,
+         c11, c12, c13, c22, c23, c33, vx, vy, vz, alpha, dt_b),
+        cfg.group,
+    )
+    jp = pack_j_fields((x, y, z, c, vx, vy, vz, xm / kx, divv), cfg.dma_cap)
+    alpha_new, _nc = engine(ranges, i_fields, jp)
+    return alpha_new.reshape(-1)[:n], ranges.occupancy
+
+
+def pallas_momentum_energy_ve(
+    x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
+    c11, c12, c13, c22, c23, c33,
+    sorted_keys, box: Box, const, cfg: NeighborConfig, nc=None,
+    gradv=None, ranges=None, interpret: bool = False,
+):
+    """VE momentum + energy (momentum_energy_kern.hpp:65-222) with the
+    search fused in: Atwood-ramped crossed/uncrossed volume elements,
+    per-particle alpha viscosity, optional avClean gradV correction.
+    Returns (ax, ay, az, du, min_dt, occupancy).
+
+    The Atwood ramp's per-pair powers xm^(2-sigma) xm_j^sigma are
+    evaluated as xm_i^2 exp(sigma (ln xm_j - ln xm_i)) with the logs
+    precomputed per particle — one exp per pair side instead of pow().
+    """
+    n = x.shape[0]
+    wc = sinc_poly_coeffs(float(const.sinc_index))
+    K = float(const.K)
+    k_cour = float(const.k_cour)
+    at_min = float(const.at_min)
+    at_max = float(const.at_max)
+    ramp = float(const.ramp)
+    av_clean = gradv is not None
+
+    if ranges is None:
+        ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
+
+    NI = 23 + (7 if av_clean else 0)
+    NJ = 23 + (6 if av_clean else 0)
+
+    def pair_body(geom, i_fields, j_fields, accs):
+        momx, momy, momz, energy, avisc_e, maxvs = accs
+        (xi, yi, zi, hi, inv_h2i, inv_h3i, vxi, vyi, vzi, ci, ali,
+         xmi, xm2i, lxi, rhoi, irhoi, prhoi,
+         c11i, c12i, c13i, c22i, c23i, c33i) = i_fields[:23]
+        (cx, cy, cz, inv_h2j, inv_h3j, vxj, vyj, vzj, cj, alj,
+         mj, xmj, xm2j, lxj, rhoj, irhoj, prhoj,
+         c11j, c12j, c13j, c22j, c23j, c33j) = j_fields[:23]
+
+        u_i = geom.d2 * inv_h2i
+        u_j = geom.d2 * inv_h2j
+        # negative normalization bakes the VE kernels' tA = -(C r) W
+        # projection sign into w (iad_project sign=-1)
+        w_i = -_w_poly(u_i, wc) * inv_h3i
+        w_j = -_w_poly(u_j, wc) * inv_h3j
+
+        vx_ij = vxi - vxj
+        vy_ij = vyi - vyj
+        vz_ij = vzi - vzj
+        rv = geom.rx * vx_ij + geom.ry * vy_ij + geom.rz * vz_ij
+        inv_dist = jax.lax.rsqrt(geom.d2)
+
+        if av_clean:
+            eta_crit = i_fields[23]
+            gvi = i_fields[24:30]
+            gvj = j_fields[23:29]
+            sym = lambda gv: (
+                geom.rx * (gv[0] * geom.rx + gv[1] * geom.ry + gv[2] * geom.rz)
+                + geom.ry * (gv[3] * geom.ry + gv[4] * geom.rz)
+                + geom.rz * (gv[5] * geom.rz)
+            )
+            d1 = sym(gvi)
+            d2_ = sym(gvj)
+            eta_ab = jnp.minimum(jnp.sqrt(u_i), jnp.sqrt(u_j))
+            eta_diff = 5.0 * (eta_ab - eta_crit)
+            d3 = jnp.where(
+                eta_ab < eta_crit, jnp.exp(-(eta_diff * eta_diff)), 1.0
+            )
+            A = jnp.where(d2_ != 0.0, d1 / d2_, 0.0)
+            Ap1 = 1.0 + A
+            phi = 0.5 * d3 * jnp.clip(4.0 * A / (Ap1 * Ap1), 0.0, 1.0)
+            rv = rv - phi * (d1 + d2_)
+
+        w_ij = rv * inv_dist
+        # per-particle-alpha Monaghan AV (kernels.hpp:60-84)
+        cij = ci + cj
+        v_sig = 0.25 * (ali + alj) * cij - 2.0 * w_ij
+        visc = jnp.where(w_ij < 0.0, -v_sig * w_ij, 0.0)
+        maxvs = jnp.maximum(
+            maxvs, jnp.where(geom.mask, 0.5 * cij - 2.0 * w_ij, 0.0)
+        )
+
+        tA1_i = (c11i * geom.rx + c12i * geom.ry + c13i * geom.rz) * w_i
+        tA2_i = (c12i * geom.rx + c22i * geom.ry + c23i * geom.rz) * w_i
+        tA3_i = (c13i * geom.rx + c23i * geom.ry + c33i * geom.rz) * w_i
+        tA1_j = (c11j * geom.rx + c12j * geom.ry + c13j * geom.rz) * w_j
+        tA2_j = (c12j * geom.rx + c22j * geom.ry + c23j * geom.rz) * w_j
+        tA3_j = (c13j * geom.rx + c23j * geom.ry + c33j * geom.rz) * w_j
+
+        # Atwood ramp between uncrossed (xm_i^2, xm_j^2) and crossed
+        # (xm_i xm_j) volume elements
+        atwood = jnp.abs(rhoi - rhoj) / (rhoi + rhoj)
+        sigma = ramp * (atwood - at_min)
+        dl = lxj - lxi
+        a_ramp = xm2i * jnp.exp(sigma * dl)
+        b_ramp = xm2j * jnp.exp(-sigma * dl)
+        crossed = xmi * xmj
+        a_mom = jnp.where(
+            atwood < at_min, xm2i,
+            jnp.where(atwood > at_max, crossed, a_ramp),
+        )
+        b_mom = jnp.where(
+            atwood < at_min, xm2j,
+            jnp.where(atwood > at_max, crossed, b_ramp),
+        )
+
+        a_visc = mj * irhoi * visc
+        b_visc = mj * irhoj * visc
+        avx = 0.5 * (a_visc * tA1_i + b_visc * tA1_j)
+        avy = 0.5 * (a_visc * tA2_i + b_visc * tA2_j)
+        avz = 0.5 * (a_visc * tA3_i + b_visc * tA3_j)
+        mm = geom.mask
+        avisc_e = avisc_e + jnp.where(
+            mm, avx * vx_ij + avy * vy_ij + avz * vz_ij, 0.0
+        )
+        energy = energy + jnp.where(
+            mm,
+            mj * a_mom * (vx_ij * tA1_i + vy_ij * tA2_i + vz_ij * tA3_i),
+            0.0,
+        )
+        mom_i = mj * prhoi * a_mom
+        mom_j = mj * prhoj * b_mom
+        momx = momx + jnp.where(mm, mom_i * tA1_i + mom_j * tA1_j + avx, 0.0)
+        momy = momy + jnp.where(mm, mom_i * tA2_i + mom_j * tA2_j + avy, 0.0)
+        momz = momz + jnp.where(mm, mom_i * tA3_i + mom_j * tA3_j + avz, 0.0)
+        return momx, momy, momz, energy, avisc_e, maxvs
+
+    def finalize(i_fields, accs, nc_):
+        hi = i_fields[3]
+        ci = i_fields[9]
+        prhoi = i_fields[16]
+        momx, momy, momz, energy, avisc_e, maxvs = accs
+        red = lambda a: jnp.sum(a, axis=1, keepdims=True)
+        avisc = jnp.maximum(red(avisc_e), 0.0)
+        du = K * (prhoi * red(energy) + 0.5 * avisc)
+        mv = jnp.max(maxvs, axis=1, keepdims=True)
+        v = jnp.where(mv > 0.0, mv, ci)
+        dt_i = k_cour * hi / v
+        return (-K * red(momx), -K * red(momy), -K * red(momz), du, dt_i)
+
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=NI, num_j=NJ, num_acc=6, cfg=cfg,
+        fold=engine_fold(box, cfg), interpret=interpret,
+    )
+    inv_h2 = 1.0 / (h * h)
+    inv_h3 = inv_h2 / h
+    rho = kx * m / xm
+    inv_rho = 1.0 / rho
+    lx = jnp.log(xm)
+    extra_i = [inv_h2, inv_h3, vx, vy, vz, c, alpha, xm, xm * xm, lx,
+               rho, inv_rho, prho, c11, c12, c13, c22, c23, c33]
+    jfields = [x, y, z, inv_h2, inv_h3, vx, vy, vz, c, alpha, m, xm,
+               xm * xm, lx, rho, inv_rho, prho,
+               c11, c12, c13, c22, c23, c33]
+    if av_clean:
+        eta_crit = jnp.cbrt(
+            32.0 * np.pi / 3.0 / (nc.astype(jnp.float32) + 1.0)
+        )
+        extra_i = extra_i + [eta_crit] + list(gradv)
+        jfields = jfields + list(gradv)
+    i_fields = _prep_i(x, y, z, h, tuple(extra_i), cfg.group)
+    jp = pack_j_fields(tuple(jfields), cfg.dma_cap)
     ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp)
     f = lambda a: a.reshape(-1)[:n]
     return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy
