@@ -64,22 +64,37 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std)
     tree arrays stay replicated across the mesh, matching the reference's
     replicated global octree (assignment.hpp:51-53).
     """
-    # GSPMD has no auto-partitioning rule for Mosaic (pallas) custom calls;
-    # the sharded step therefore always runs the XLA pair path — the pallas
-    # engine is the single-chip fast path until it gains a shard_map wrapper
+    # GSPMD has no auto-partitioning rule for Mosaic (pallas) custom calls,
+    # so the pallas pair stage runs under an explicit shard_map: each
+    # device executes the fused engine on its SFC slab
+    # (propagator._std_forces_sharded). The VE engine has no shard wrapper
+    # yet — those steps fall back to the GSPMD-partitioned XLA path.
     if cfg.backend == "pallas":
-        cfg = dataclasses.replace(cfg, backend="xla")
+        if step_fn is step_hydro_std:
+            cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p")
+        else:
+            cfg = dataclasses.replace(cfg, backend="xla")
 
     pspec = NamedSharding(mesh, P("p"))
+
+    rspec = NamedSharding(mesh, P())
 
     def stepper(s, b, gtree=None):
         new_state, new_box, diag = step_fn(s, b, cfg, gtree)
         # keep the particle arrays sharded on the way out so the next step
-        # starts from slab-owned arrays (no silent replication creep)
+        # starts from slab-owned arrays (no silent replication creep)...
         constrain = lambda l: (
             jax.lax.with_sharding_constraint(l, pspec) if l.ndim >= 1 else l
         )
-        return jax.tree.map(constrain, new_state), new_box, diag
+        # ...and the (3,)-vector box replicated — a stray P('p') sharding
+        # on it changes the call signature and forces a full recompile on
+        # the second step
+        rep = lambda l: (
+            jax.lax.with_sharding_constraint(l, rspec)
+            if getattr(l, "ndim", 0) >= 1 else l
+        )
+        return (jax.tree.map(constrain, new_state),
+                jax.tree.map(rep, new_box), diag)
 
     # inputs are placed by shard_state; GSPMD propagates those shardings
     # through the whole program, one compiled executable reused every step

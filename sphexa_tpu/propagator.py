@@ -67,6 +67,12 @@ class PropagatorConfig:
     # 'pallas': fused search+op TPU kernels for the std pipeline
     # (sph/pallas_pairs.py); 'xla': portable gather-based path
     backend: str = "xla"
+    # multi-chip fast path: when set (with backend='pallas'), the pair-op
+    # stage runs under shard_map over ``mesh`` — each device executes the
+    # Mosaic engine on its SFC slab, with all_gather supplying the j-side
+    # candidate arrays (the halo-exchange analog; see _std_forces_sharded)
+    mesh: Optional[object] = None
+    shard_axis: Optional[str] = None
 
 
 def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
@@ -171,6 +177,78 @@ def _integrate_and_finish(
     return new_state, box, diagnostics
 
 
+def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
+    """std pair-op stage under shard_map: per-device Mosaic kernels on the
+    device's SFC slab.
+
+    The arrays arrive GLOBALLY sorted and slab-sharded (the sort is the
+    domain redistribution, parallel/mesh.py). Each shard all_gathers the
+    j-side candidate fields over ICI — the role of the reference's
+    exchangeHalos calls between kernels (std_hydro.hpp:131-151), with the
+    whole sorted array standing in for the halo regions until a
+    cell-granular exchange replaces it — and runs the fused engine on its
+    local targets. Scalar guards/timesteps are pmax/pmin-reduced so every
+    shard returns identical values.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+    from sphexa_tpu.sph import pallas_pairs as pp
+
+    axis = cfg.shard_axis
+    const = cfg.const
+    nbr = cfg.nbr
+    interpret = _pallas_interpret()
+
+    def forces(box, keys, x, y, z, h, m, vx, vy, vz, temp):
+        ag = lambda a: jax.lax.all_gather(a, axis, tiled=True)
+        xg, yg, zg, hg, mg = ag(x), ag(y), ag(z), ag(h), ag(m)
+        keys_g = ag(keys)
+        i_offset = jax.lax.axis_index(axis) * x.shape[0]
+
+        ranges = pp.group_cell_ranges(x, y, z, h, keys_g, box, nbr)
+        rho, nc, occ = pp.pallas_density(
+            x, y, z, h, m, keys_g, box, const, nbr, ranges=ranges,
+            jdata=(xg, yg, zg, mg), i_offset=i_offset, interpret=interpret,
+        )
+        p, c = hydro_std.compute_eos_std(temp, rho, const)
+        # the freshly computed fields the next ops read on the j side are
+        # re-gathered — the exchangeHalos(rho, p, c) analog
+        rho_g = ag(rho)
+        # vol (5th arg) only feeds the j-side pack, which jdata replaces
+        # here — the candidate volumes are the GLOBAL mg / rho_g
+        cs, _ = pp.pallas_iad(
+            x, y, z, h, m / rho, keys_g, box, const, nbr, ranges=ranges,
+            jdata=(xg, yg, zg, mg / rho_g), i_offset=i_offset,
+            interpret=interpret,
+        )
+        vxg, vyg, vzg = ag(vx), ag(vy), ag(vz)
+        pg, cg = ag(p), ag(c)
+        cs_g = tuple(ag(a) for a in cs)
+        ax, ay, az, du, dt_c, _ = pp.pallas_momentum_energy_std(
+            x, y, z, vx, vy, vz, h, m, rho, p, c, *cs,
+            keys_g, box, const, nbr, ranges=ranges,
+            jdata=(xg, yg, zg, hg, vxg, vyg, vzg, mg, rho_g, pg, cg, *cs_g),
+            i_offset=i_offset, interpret=interpret,
+        )
+        occ = jax.lax.pmax(occ, axis)
+        dt_c = jax.lax.pmin(dt_c, axis)
+        return rho, c, nc, occ, ax, ay, az, du, dt_c
+
+    Pp, Pr = PartitionSpec(axis), PartitionSpec()
+    # check_vma=False: pallas_call's out_shape carries no varying-axis
+    # metadata, which the checker (correctly) refuses to infer; the pmax/
+    # pmin reductions above guarantee the replicated outputs really are
+    out = shard_map(
+        forces,
+        mesh=cfg.mesh,
+        in_specs=(Pr, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp),
+        out_specs=(Pp, Pp, Pp, Pr, Pp, Pp, Pp, Pp, Pr),
+        check_vma=False,
+    )(box, keys, state.x, state.y, state.z, state.h, state.m,
+      state.vx, state.vy, state.vz, state.temp)
+    return out
+
+
 def _std_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree], aux=None,
@@ -187,24 +265,32 @@ def _std_forces(
     state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 
-    if cfg.backend == "pallas":
+    if cfg.backend == "pallas" and cfg.shard_axis is not None:
+        # multi-chip fast path: per-shard Mosaic kernels under shard_map
+        (rho, c, nc, occ, ax, ay, az, du, dt_courant) = _std_forces_sharded(
+            state, box, cfg, keys
+        )
+    elif cfg.backend == "pallas":
         # fused search+op TPU kernels: one shared cell-range prologue,
         # neighbor lists never materialize (sph/pallas_pairs.py)
         from sphexa_tpu.sph import pallas_pairs as pp
 
+        interp = _pallas_interpret()
         ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
         occ = ranges.occupancy
         rho, nc, _ = pp.pallas_density(
-            x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges
+            x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges,
+            interpret=interp,
         )
         p, c = hydro_std.compute_eos_std(state.temp, rho, const)
         (c11, c12, c13, c22, c23, c33), _ = pp.pallas_iad(
-            x, y, z, h, m / rho, keys, box, const, cfg.nbr, ranges=ranges
+            x, y, z, h, m / rho, keys, box, const, cfg.nbr, ranges=ranges,
+            interpret=interp,
         )
         ax, ay, az, du, dt_courant, _ = pp.pallas_momentum_energy_std(
             x, y, z, state.vx, state.vy, state.vz, h, m, rho, p, c,
             c11, c12, c13, c22, c23, c33, keys, box, const, cfg.nbr,
-            ranges=ranges,
+            ranges=ranges, interpret=interp,
         )
     else:
         nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
@@ -287,6 +373,12 @@ def step_hydro_std_cooling(
     return new_state, box, diag, chem
 
 
+def _pallas_interpret() -> bool:
+    """Run Mosaic kernels in interpret mode off-TPU (single policy for
+    the std, VE and sharded pallas paths)."""
+    return jax.default_backend() != "tpu"
+
+
 def _split_dvout(dvout, av_clean: bool):
     """Unpack the divv/curlv op's outputs (shared by both VE backends)."""
     if av_clean:
@@ -318,25 +410,29 @@ def _ve_forces(
         # fast path, sharing one cell-range prologue across all six ops
         from sphexa_tpu.sph import pallas_pairs as pp
 
+        interp = _pallas_interpret()
         ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
         occ = ranges.occupancy
         xm, nc, _ = pp.pallas_xmass(
-            x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges
+            x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges,
+            interpret=interp,
         )
         (kx, gradh), _ = pp.pallas_ve_def_gradh(
-            x, y, z, h, m, xm, keys, box, const, cfg.nbr, ranges=ranges
+            x, y, z, h, m, xm, keys, box, const, cfg.nbr, ranges=ranges,
+            interpret=interp,
         )
         prho, c, rho, p = hydro_ve.compute_eos_ve(
             state.temp, m, kx, xm, gradh, const
         )
         (c11, c12, c13, c22, c23, c33), _ = pp.pallas_iad(
-            x, y, z, h, xm / kx, keys, box, const, cfg.nbr, ranges=ranges
+            x, y, z, h, xm / kx, keys, box, const, cfg.nbr, ranges=ranges,
+            interpret=interp,
         )
         dvout, _ = pp.pallas_iad_divv_curlv(
             x, y, z, vx, vy, vz, h, kx, xm,
             c11, c12, c13, c22, c23, c33,
             keys, box, const, cfg.nbr, ranges=ranges,
-            with_gradv=cfg.av_clean,
+            with_gradv=cfg.av_clean, interpret=interp,
         )
         divv, curlv, gradv = _split_dvout(dvout, cfg.av_clean)
         dt_rho = rho_timestep(divv, const)
@@ -345,11 +441,13 @@ def _ve_forces(
             x, y, z, vx, vy, vz, h, c, kx, xm, divv, state.alpha,
             c11, c12, c13, c22, c23, c33,
             keys, box, state.min_dt, const, cfg.nbr, ranges=ranges,
+            interpret=interp,
         )
         ax, ay, az, du, dt_courant, _ = pp.pallas_momentum_energy_ve(
             x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
             c11, c12, c13, c22, c23, c33,
             keys, box, const, cfg.nbr, nc=nc, gradv=gradv, ranges=ranges,
+            interpret=interp,
         )
     else:
         nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)

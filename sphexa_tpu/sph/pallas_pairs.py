@@ -305,12 +305,14 @@ def _merge_runs(start, lens, keep, shifts, run_cap: int, gap: int):
         )
         return carry, k_w & ~join
 
+    # inits derived from the inputs so their varying-manual-axes match
+    # under shard_map (a plain jnp.zeros carry is rejected by check_vma)
     init = (
-        jnp.zeros(ng, jnp.int32),
-        jnp.full((ng,), -INF, jnp.int32),
-        jnp.zeros(ng, jnp.float32),
-        jnp.zeros(ng, jnp.float32),
-        jnp.zeros(ng, jnp.float32),
+        jnp.zeros_like(s[:, 0]),
+        jnp.full_like(s[:, 0], -INF),
+        jnp.zeros_like(shx[:, 0]),
+        jnp.zeros_like(shy[:, 0]),
+        jnp.zeros_like(shz[:, 0]),
     )
     xs = tuple(a.T for a in (s, l, k, shx, shy, shz))
     _, is_head_t = jax.lax.scan(fstep, init, xs)
@@ -326,7 +328,7 @@ def _merge_runs(start, lens, keep, shifts, run_cap: int, gap: int):
         return r, r
 
     xs_r = (end_eff[:, ::-1].T, head_next[:, ::-1].T)
-    _, r_t = jax.lax.scan(rstep, jnp.full((ng,), -1, jnp.int32), xs_r)
+    _, r_t = jax.lax.scan(rstep, jnp.full_like(end_eff[:, 0], -1), xs_r)
     run_end = r_t.T[:, ::-1]
 
     # compact heads to the front (stable: preserves key order)
@@ -403,10 +405,10 @@ def group_pair_engine(
     nf_pad = _round_up(num_j, 8)
 
     def kernel(*refs):
-        starts, lens, shx_r, shy_r, shz_r, ncells, boxl = refs[:7]
-        i_refs = refs[7 : 7 + num_i]
-        jref = refs[7 + num_i]
-        out_refs = refs[8 + num_i : -2]
+        starts, lens, shx_r, shy_r, shz_r, ncells, boxl, ioff = refs[:8]
+        i_refs = refs[8 : 8 + num_i]
+        jref = refs[8 + num_i]
+        out_refs = refs[9 + num_i : -2]
         nc_ref = refs[-2]
         buf, sems = refs[-1]  # unpacked below
 
@@ -427,7 +429,13 @@ def group_pair_engine(
 
         i_fields = [r[0, 0][:, None] for r in i_refs]  # (G, 1) each
         xi, yi, zi, hi = i_fields[:4]
-        tgt_idx = gi * G + jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+        # global index of the first target: shard offset + group offset
+        # (candidate indices are GLOBAL sorted-array positions, so the
+        # self-pair test must compare in global index space)
+        tgt_idx = (
+            ioff[0, 0, 0] + gi * G
+            + jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+        )
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
         h4 = 4.0 * hi * hi
         lx, ly, lz = boxl[0, 0, 0], boxl[0, 0, 1], boxl[0, 0, 2]
@@ -494,8 +502,10 @@ def group_pair_engine(
         # scratch unpack shim: keep kernel() readable
         kernel(*refs[:-2], (refs[-2], refs[-1]))
 
-    def call(ranges: GroupRanges, i_fields: Sequence, j_packed):
+    def call(ranges: GroupRanges, i_fields: Sequence, j_packed,
+             i_offset=0):
         num_groups = ranges.num_groups
+        ioff = jnp.asarray(i_offset, jnp.int32).reshape(1, 1, 1)
         smem3 = lambda a: a.reshape(num_groups, 1, w3)
         starts = smem3(ranges.starts)
         lens = smem3(ranges.lens)
@@ -528,6 +538,8 @@ def group_pair_engine(
                 smem_spec((1, 1, 1)),   # ncells
                 pl.BlockSpec((1, 1, 3), lambda g: (0, 0, 0),
                              memory_space=pltpu.SMEM),  # boxl
+                pl.BlockSpec((1, 1, 1), lambda g: (0, 0, 0),
+                             memory_space=pltpu.SMEM),  # i_offset
             ]
             + [
                 pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
@@ -553,7 +565,8 @@ def group_pair_engine(
             grid_spec=grid_spec,
             out_shape=out_shape,
             interpret=interpret,
-        )(starts, lens, shx, shy, shz, ncells, boxl, *i_fields, j_packed)
+        )(starts, lens, shx, shy, shz, ncells, boxl, ioff, *i_fields,
+          j_packed)
         return outs
 
     return call
@@ -580,12 +593,17 @@ _w_poly = sinc_poly_eval
 
 def pallas_density(
     x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
-    ranges=None, interpret: bool = False,
+    ranges=None, interpret: bool = False, jdata=None, i_offset=0,
 ):
     """rho_i = K h_i^-3 (m_i + sum_j m_j W(|r_ij|/h_i)) + neighbor counts.
 
     Pallas instantiation of hydro_std.compute_density (density.hpp:41) with
     the search fused in. Returns (rho (n,), nc (n,), occupancy).
+
+    Under shard_map, the i-side arrays are the local slab while ``jdata``
+    supplies the GLOBAL (all-gathered) candidate arrays (x, y, z, m) that
+    ``sorted_keys``/``ranges`` index into, and ``i_offset`` is the slab's
+    global start index (for the self-pair test).
     """
     n = x.shape[0]
     coeffs = sinc_poly_coeffs(float(const.sinc_index))
@@ -613,18 +631,23 @@ def pallas_density(
         fold=engine_fold(box, cfg), interpret=interpret,
     )
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m), cfg.group)
-    jp = pack_j_fields((x, y, z, m), cfg.dma_cap)
-    rho, nc = engine(ranges, i_fields, jp)
+    jp = pack_j_fields(jdata or (x, y, z, m), cfg.dma_cap)
+    rho, nc = engine(ranges, i_fields, jp, i_offset)
     return rho.reshape(-1)[:n], nc.reshape(-1)[:n], ranges.occupancy
 
 
 def pallas_iad(
     x, y, z, h, vol, sorted_keys, box: Box, const, cfg: NeighborConfig,
-    ranges=None, interpret: bool = False,
+    ranges=None, interpret: bool = False, jdata=None, i_offset=0,
 ):
     """IAD tensor components (hydro_std.compute_iad, iad_kern.hpp) with the
     neighbor search fused in. ``vol`` is the per-particle volume estimate
-    (m/rho std, xm/kx VE). Returns (c11..c33, occupancy)."""
+    (m/rho std, xm/kx VE). Returns (c11..c33, occupancy).
+
+    Under shard_map, ``jdata = (x, y, z, vol)`` supplies the GLOBAL
+    j-side arrays (making the local ``vol`` argument j-side-dead) and
+    ``i_offset`` the slab's global start index — same contract as
+    pallas_density."""
     n = x.shape[0]
     coeffs = sinc_poly_coeffs(float(const.sinc_index))
     K = float(const.K)
@@ -675,8 +698,8 @@ def pallas_iad(
         fold=engine_fold(box, cfg), interpret=interpret,
     )
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h),), cfg.group)
-    jp = pack_j_fields((x, y, z, vol), cfg.dma_cap)
-    *cs, _nc = engine(ranges, i_fields, jp)
+    jp = pack_j_fields(jdata or (x, y, z, vol), cfg.dma_cap)
+    *cs, _nc = engine(ranges, i_fields, jp, i_offset)
     return tuple(c.reshape(-1)[:n] for c in cs), ranges.occupancy
 
 
@@ -684,7 +707,7 @@ def pallas_momentum_energy_std(
     x, y, z, vx, vy, vz, h, m, rho, p, c,
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, const, cfg: NeighborConfig,
-    ranges=None, interpret: bool = False,
+    ranges=None, interpret: bool = False, jdata=None, i_offset=0,
 ):
     """Pressure-gradient accelerations + energy rate + Courant dt
     (hydro_std.compute_momentum_energy_std, momentum_energy_kern.hpp:12-134)
@@ -783,12 +806,18 @@ def pallas_momentum_energy_std(
          c11, c12, c13, c22, c23, c33),
         cfg.group,
     )
-    jp = pack_j_fields(
-        (x, y, z, inv_h2, vx, vy, vz, c, m, m / (rho * h * h * h), p / rho,
-         c11, c12, c13, c22, c23, c33),
-        cfg.dma_cap,
-    )
-    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp)
+    if jdata is None:
+        jfields = (x, y, z, inv_h2, vx, vy, vz, c, m,
+                   m / (rho * h * h * h), p / rho,
+                   c11, c12, c13, c22, c23, c33)
+    else:
+        (xj, yj, zj, hj, vxj, vyj, vzj, mj, rhoj, pj, cj,
+         j11, j12, j13, j22, j23, j33) = jdata
+        jfields = (xj, yj, zj, 1.0 / (hj * hj), vxj, vyj, vzj, cj, mj,
+                   mj / (rhoj * hj * hj * hj), pj / rhoj,
+                   j11, j12, j13, j22, j23, j33)
+    jp = pack_j_fields(jfields, cfg.dma_cap)
+    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp, i_offset)
     f = lambda a: a.reshape(-1)[:n]
     return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy
 
@@ -805,14 +834,14 @@ def pallas_momentum_energy_std(
 
 def pallas_xmass(
     x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
-    ranges=None, interpret: bool = False,
+    ranges=None, interpret: bool = False, jdata=None, i_offset=0,
 ):
     """Generalized volume element xm_i = m_i / rho0_i (xmass_kern.hpp:50-79)
     + neighbor counts. rho0 is exactly the std kernel-summed density, so
     this delegates to pallas_density. Returns (xm (n,), nc (n,), occ)."""
     rho0, nc, occ = pallas_density(
         x, y, z, h, m, sorted_keys, box, const, cfg,
-        ranges=ranges, interpret=interpret,
+        ranges=ranges, interpret=interpret, jdata=jdata, i_offset=i_offset,
     )
     return m / rho0, nc, occ
 
@@ -866,7 +895,7 @@ def pallas_ve_def_gradh(
     )
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m, xm), cfg.group)
     jp = pack_j_fields((x, y, z, m, xm), cfg.dma_cap)
-    kx, gradh, _nc = engine(ranges, i_fields, jp)
+    kx, gradh, _nc = engine(ranges, i_fields, jp)  # single-chip (no jdata yet)
     f = lambda a: a.reshape(-1)[:n]
     return (f(kx), f(gradh)), ranges.occupancy
 
@@ -1035,6 +1064,8 @@ def pallas_av_switches(
         pair_body, finalize, num_i=19, num_j=9, num_acc=4, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret,
     )
+    # dt rides along as a constant i-field: one (1, 1, G) block DMA per
+    # group (~256 B) — not worth a second engine scalar-operand mechanism
     dt_b = jnp.broadcast_to(jnp.asarray(dt, jnp.float32), x.shape)
     i_fields = _prep_i(
         x, y, z, h,
