@@ -35,8 +35,35 @@ def _output_fields(
     x, y, z, h, m = (g(state.x), g(state.y), g(state.z), g(state.h), g(state.m))
     temp = g(state.temp)
 
-    nidx, nmask, _, _ = find_neighbors(x, y, z, h, skeys, box, cfg.nbr)
-    if pipeline == "ve":
+    if cfg.backend == "pallas":
+        # the fused engine avoids the XLA path's (N, W3*cap) candidate
+        # materialization, which can exceed HBM for strongly compressed
+        # states (e.g. Noh's center drives the cell cap into the 1000s)
+        from sphexa_tpu.propagator import _pallas_interpret
+        from sphexa_tpu.sph import pallas_pairs as pp
+
+        interp = _pallas_interpret()
+        ranges = pp.group_cell_ranges(x, y, z, h, skeys, box, cfg.nbr)
+        if pipeline == "ve":
+            xm, _, _ = pp.pallas_xmass(
+                x, y, z, h, m, skeys, box, cfg.const, cfg.nbr,
+                ranges=ranges, interpret=interp,
+            )
+            (kx, gradh), _ = pp.pallas_ve_def_gradh(
+                x, y, z, h, m, xm, skeys, box, cfg.const, cfg.nbr,
+                ranges=ranges, interpret=interp,
+            )
+            _, c, rho, p = hydro_ve.compute_eos_ve(
+                temp, m, kx, xm, gradh, cfg.const
+            )
+        else:
+            rho, _, _ = pp.pallas_density(
+                x, y, z, h, m, skeys, box, cfg.const, cfg.nbr,
+                ranges=ranges, interpret=interp,
+            )
+            p, c = hydro_std.compute_eos_std(temp, rho, cfg.const)
+    elif pipeline == "ve":
+        nidx, nmask, _, _ = find_neighbors(x, y, z, h, skeys, box, cfg.nbr)
         # VE-consistent density/EOS (the saveFields recompute pass,
         # ve_hydro.hpp:225-286): rho = kx m / xm with gradh normalization
         xm = hydro_ve.compute_xmass(
@@ -47,6 +74,7 @@ def _output_fields(
         )
         _, c, rho, p = hydro_ve.compute_eos_ve(temp, m, kx, xm, gradh, cfg.const)
     else:
+        nidx, nmask, _, _ = find_neighbors(x, y, z, h, skeys, box, cfg.nbr)
         rho = hydro_std.compute_density(
             x, y, z, h, m, nidx, nmask, box, cfg.const, cfg.block
         )
