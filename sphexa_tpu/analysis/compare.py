@@ -39,10 +39,9 @@ def _output_fields(
         # the fused engine avoids the XLA path's (N, W3*cap) candidate
         # materialization, which can exceed HBM for strongly compressed
         # states (e.g. Noh's center drives the cell cap into the 1000s)
-        from sphexa_tpu.propagator import _pallas_interpret
         from sphexa_tpu.sph import pallas_pairs as pp
 
-        interp = _pallas_interpret()
+        interp = pp.pallas_interpret()
         ranges = pp.group_cell_ranges(x, y, z, h, skeys, box, cfg.nbr)
         if pipeline == "ve":
             xm, _, _ = pp.pallas_xmass(

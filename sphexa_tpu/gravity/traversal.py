@@ -50,6 +50,11 @@ class GravityConfig:
     p2p_cap: int = 48  # max near-field leaves per target group
     leaf_cap: int = 128  # max particles gathered per near-field leaf
     G: float = 1.0
+    # near-field engine: stream the P2P leaf ranges through the pallas
+    # pair engine (sph/pallas_pairs.py) instead of XLA gathers — the
+    # dominant cost of the XLA formulation at 1e5+ particles. Set by the
+    # Simulation from the step backend (TPU only; CPU tests keep XLA).
+    use_pallas: bool = False
 
 
 def estimate_gravity_caps(
@@ -164,6 +169,79 @@ def compute_multipoles(
     return node_mass, node_com, node_q, edges
 
 
+def _pallas_p2p(x, y, z, m, h, shift, allow_self, cfg: GravityConfig,
+                starts, lens):
+    """Near-field P2P through the streamed pair engine.
+
+    ``starts``/``lens`` are the per-block near-leaf ranges from the MAC
+    classification, (NB, p2p_cap) in GLOBAL sorted-array offsets. Leaf
+    ranges are contiguous, so adjacent ones merge into long DMA runs —
+    with gap=0 ONLY: a bridged gap would stream particles of leaves whose
+    mass already arrives via M2P (no distance cutoff masks them away),
+    double-counting. Returns (ax, ay, az, phi), each (NB*block,).
+    """
+    from sphexa_tpu.neighbors.cell_list import NeighborConfig
+    from sphexa_tpu.sph import pallas_pairs as pp
+
+    nb = starts.shape[0]
+    blk = cfg.target_block
+    nbr = NeighborConfig(
+        level=1, cap=cfg.leaf_cap, group=blk,
+        run_cap=max(cfg.leaf_cap, 1024), gap=0,
+    )
+    zero3 = jnp.zeros(starts.shape + (3,), jnp.float32)
+    rs, rl, sh3, nruns = pp._merge_runs(
+        starts, lens, lens > 0, zero3, nbr.run_cap, 0
+    )
+    ranges = pp.GroupRanges(
+        starts=rs, lens=rl, shift_x=sh3[0], shift_y=sh3[1], shift_z=sh3[2],
+        ncells=nruns, occupancy=jnp.int32(0),
+        boxl=jnp.full((3,), 1e30, jnp.float32),
+    )
+
+    def pair_body(geom, i_fields, j_fields, accs):
+        ax, ay, az, phi = accs
+        hi = i_fields[3]
+        mj, hj = j_fields[3], j_fields[4]
+        # SPH-compatible softening: distance clamped to h_i + h_j
+        # (ryoanji/nbody/kernel.hpp:515; force vanishes linearly at r->0)
+        h_ij = hi + hj
+        r2_eff = jnp.maximum(geom.d2, h_ij * h_ij)
+        inv_r = jax.lax.rsqrt(jnp.maximum(r2_eff, 1e-30))
+        w = jnp.where(geom.mask, mj * inv_r * inv_r * inv_r, 0.0)
+        # geom.rx = x_i - x_j = -(source - target)
+        return (ax - geom.rx * w, ay - geom.ry * w, az - geom.rz * w,
+                phi - w * geom.d2)
+
+    def finalize(i_fields, accs, nc):
+        red = lambda a: jnp.sum(a, axis=1, keepdims=True)
+        return tuple(red(a) for a in accs)
+
+    engine = pp.group_pair_engine(
+        pair_body, finalize, num_i=4, num_j=5, num_acc=4, cfg=nbr,
+        fold=False, interpret=pp.pallas_interpret(),
+        num_slots=cfg.p2p_cap, pair_cutoff=False,
+    )
+    # i-side blocks padded to the classification's chunked block count
+    # (tail groups re-evaluate the last particle; trimmed by the caller)
+    npad = nb * blk
+    n = x.shape[0]
+
+    def blocked(a, off):
+        a = a + off
+        a = jnp.concatenate(
+            [a, jnp.broadcast_to(a[-1:], (npad - n,))]
+        ) if npad > n else a
+        return a.reshape(nb, blk)
+
+    i_fields = [blocked(x, shift[0]), blocked(y, shift[1]),
+                blocked(z, shift[2]), blocked(h, 0.0)]
+    jp = pp.pack_j_fields((x, y, z, m, h), nbr.dma_cap)
+    ax, ay, az, phi, _nc = engine(ranges, i_fields, jp, 0, allow_self)
+    f = lambda a: a.reshape(-1)
+    return f(ax), f(ay), f(az), f(phi)
+
+
 @functools.partial(jax.jit, static_argnames=("meta", "cfg", "with_phi"))
 def compute_gravity(
     x, y, z, m, h, sorted_keys, box: Box,
@@ -218,6 +296,14 @@ def compute_gravity(
 
     leaf_occ = jnp.max(edges[1:] - edges[:-1])
 
+    # packed node payload for ONE row-gather per block (com 3, q 7, mass 1
+    # padded to 12): per-field gathers tripled the M2P memory traffic
+    node_packed = jnp.concatenate(
+        [node_com, node_q, node_mass[:, None],
+         jnp.zeros((num_n, 1), node_com.dtype)],
+        axis=1,
+    )
+
     def one_block(bi):
         """bi: (blk,) particle indices of one target group."""
         tx, ty, tz, th = x[bi] + shift[0], y[bi] + shift[1], z[bi] + shift[2], h[bi]
@@ -249,17 +335,23 @@ def compute_gravity(
 
         order = jnp.argsort(~m2p_mask, stable=True)[: cfg.m2p_cap]
         m2p_ok = m2p_mask[order]
+        nd = node_packed[order]  # one row gather
         ax, ay, az, phi = mp.m2p(
-            tx, ty, tz, node_com[order], node_q[order], node_mass[order], m2p_ok
+            tx, ty, tz, nd[:, 0:3], nd[:, 3:10], nd[:, 10], m2p_ok
         )
 
         order_p = jnp.argsort(~p2p_mask, stable=True)[: cfg.p2p_cap]
         p2p_ok = p2p_mask[order_p]
         lidx = tree.leaf_of_node[order_p]  # (P,)
-        start = edges[lidx]
-        end = edges[lidx + 1]
+        start = jnp.where(p2p_ok, edges[lidx], 0)
+        length = jnp.where(p2p_ok, edges[lidx + 1] - edges[lidx], 0)
+
+        if cfg.use_pallas:
+            # defer the near field to the streamed engine (below)
+            return ax, ay, az, phi, m2p_n, p2p_n, start, length
+
         cand = start[:, None] + jnp.arange(cfg.leaf_cap, dtype=jnp.int32)
-        cand_ok = (cand < end[:, None]) & p2p_ok[:, None]
+        cand_ok = (cand < (start + length)[:, None]) & p2p_ok[:, None]
         cand = jnp.clip(cand, 0, n - 1).reshape(-1)  # (P*C,)
         cand_ok = cand_ok.reshape(-1)
         # in a shifted replica pass a particle's own image is a real pair
@@ -273,7 +365,21 @@ def compute_gravity(
     def one_chunk(bidx):
         return jax.vmap(one_block)(bidx)
 
-    ax, ay, az, phi, m2p_n, p2p_n = jax.lax.map(one_chunk, idx)
+    out = jax.lax.map(one_chunk, idx)
+    if cfg.use_pallas:
+        ax, ay, az, phi, m2p_n, p2p_n, p2p_starts, p2p_lens = out
+        pax, pay, paz, pphi = _pallas_p2p(
+            x, y, z, m, h, shift, allow_self, cfg,
+            p2p_starts.reshape(-1, cfg.p2p_cap),
+            p2p_lens.reshape(-1, cfg.p2p_cap),
+        )
+        blkpad = ax.reshape(-1).shape[0]
+        ax = ax.reshape(-1) + pax[:blkpad]
+        ay = ay.reshape(-1) + pay[:blkpad]
+        az = az.reshape(-1) + paz[:blkpad]
+        phi = phi.reshape(-1) + pphi[:blkpad]
+    else:
+        ax, ay, az, phi, m2p_n, p2p_n = out
     ax = ax.reshape(-1)[:n] * cfg.G
     ay = ay.reshape(-1)[:n] * cfg.G
     az = az.reshape(-1)[:n] * cfg.G

@@ -74,6 +74,14 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std)
             cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p")
         else:
             cfg = dataclasses.replace(cfg, backend="xla")
+    if cfg.gravity is not None and cfg.gravity.use_pallas:
+        # gravity runs in the GSPMD region (outside the pair-op
+        # shard_map), where a Mosaic custom call has no partitioning
+        # rule — keep the XLA near field until gravity gets its own
+        # shard wrapper
+        cfg = dataclasses.replace(
+            cfg, gravity=dataclasses.replace(cfg.gravity, use_pallas=False)
+        )
 
     pspec = NamedSharding(mesh, P("p"))
 

@@ -374,9 +374,11 @@ def step_hydro_std_cooling(
 
 
 def _pallas_interpret() -> bool:
-    """Run Mosaic kernels in interpret mode off-TPU (single policy for
-    the std, VE and sharded pallas paths)."""
-    return jax.default_backend() != "tpu"
+    """Run Mosaic kernels in interpret mode off-TPU (delegates to the
+    engine's single policy)."""
+    from sphexa_tpu.sph.pallas_pairs import pallas_interpret
+
+    return pallas_interpret()
 
 
 def _split_dvout(dvout, av_clean: bool):

@@ -265,7 +265,8 @@ class Simulation:
         gcfg = estimate_gravity_caps(
             xs, ys, zs, ms, skeys, self.box, gtree, meta,
             GravityConfig(theta=self.theta, bucket_size=self.grav_bucket,
-                          G=self.const.g),
+                          G=self.const.g,
+                          use_pallas=self._cfg.backend == "pallas"),
             margin=margin,
         )
         self._gtree = gtree

@@ -374,6 +374,12 @@ def pack_j_fields(fields: Sequence[jax.Array], cap: int) -> jax.Array:
     return flat.reshape(nf_pad, rows, 128).transpose(1, 0, 2)
 
 
+def pallas_interpret() -> bool:
+    """Run Mosaic kernels in interpret mode off-TPU (single policy for
+    every engine consumer — SPH ops, gravity, analysis)."""
+    return jax.default_backend() != "tpu"
+
+
 def group_pair_engine(
     pair_body: Callable,
     finalize: Callable,
@@ -383,6 +389,8 @@ def group_pair_engine(
     cfg: NeighborConfig,
     fold: bool = False,
     interpret: bool = False,
+    num_slots: int = 0,
+    pair_cutoff: bool = True,
 ):
     """Build a pallas_call for one SPH pair op.
 
@@ -397,18 +405,24 @@ def group_pair_engine(
       outs is a tuple of (G,) arrays (f32), one per output.
     - ``num_i``/``num_j``: how many target/candidate fields the op reads
       (x, y, z are always fields 0-2 on both sides; h is i-field 3).
-    - returns fn(ranges, i_fields(NG,G) x num_i, j_packed) ->
-      (outs (NG, G) x num_out, nc (NG, G)).
+    - ``num_slots``: width of the per-group range arrays (defaults to the
+      window block, cfg.window**3; gravity passes its p2p cap instead).
+    - ``pair_cutoff``: include the d2 < (2 h_i)^2 support test in the
+      pair mask (SPH); gravity's near field keeps every ranged pair.
+    - returns fn(ranges, i_fields(NG,G) x num_i, j_packed, i_offset,
+      allow_self) -> (outs (NG, G) x num_out, nc (NG, G)); ``allow_self``
+      (traced bool) admits the self-index pair — replica-image passes of
+      periodic gravity need it.
     """
-    w3 = cfg.window**3
+    w3 = num_slots or cfg.window**3
     R = _dma_rows(cfg.dma_cap)
     nf_pad = _round_up(num_j, 8)
 
     def kernel(*refs):
-        starts, lens, shx_r, shy_r, shz_r, ncells, boxl, ioff = refs[:8]
-        i_refs = refs[8 : 8 + num_i]
-        jref = refs[8 + num_i]
-        out_refs = refs[9 + num_i : -2]
+        starts, lens, shx_r, shy_r, shz_r, ncells, boxl, ioff, aself = refs[:9]
+        i_refs = refs[9 : 9 + num_i]
+        jref = refs[9 + num_i]
+        out_refs = refs[10 + num_i : -2]
         nc_ref = refs[-2]
         buf, sems = refs[-1]  # unpacked below
 
@@ -477,10 +491,10 @@ def group_pair_engine(
                     rz = zi - (j_fields[2] + shz)
                 d2 = rx * rx + ry * ry + rz * rz
                 cand = (row0 + c) * 128 + lane
-                mask = (
-                    (cand >= s) & (cand < s + ln)
-                    & (d2 < h4) & (cand != tgt_idx)
-                )
+                mask = (cand >= s) & (cand < s + ln)
+                if pair_cutoff:
+                    mask = mask & (d2 < h4)
+                mask = mask & ((cand != tgt_idx) | (aself[0, 0, 0] != 0))
                 geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
                 accs = pair_body(geom, i_fields, j_fields, accs)
                 nc_acc = nc_acc + mask.astype(jnp.int32)
@@ -503,9 +517,10 @@ def group_pair_engine(
         kernel(*refs[:-2], (refs[-2], refs[-1]))
 
     def call(ranges: GroupRanges, i_fields: Sequence, j_packed,
-             i_offset=0):
+             i_offset=0, allow_self=False):
         num_groups = ranges.num_groups
         ioff = jnp.asarray(i_offset, jnp.int32).reshape(1, 1, 1)
+        aself = jnp.asarray(allow_self, jnp.int32).reshape(1, 1, 1)
         smem3 = lambda a: a.reshape(num_groups, 1, w3)
         starts = smem3(ranges.starts)
         lens = smem3(ranges.lens)
@@ -540,6 +555,8 @@ def group_pair_engine(
                              memory_space=pltpu.SMEM),  # boxl
                 pl.BlockSpec((1, 1, 1), lambda g: (0, 0, 0),
                              memory_space=pltpu.SMEM),  # i_offset
+                pl.BlockSpec((1, 1, 1), lambda g: (0, 0, 0),
+                             memory_space=pltpu.SMEM),  # allow_self
             ]
             + [
                 pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
@@ -565,8 +582,8 @@ def group_pair_engine(
             grid_spec=grid_spec,
             out_shape=out_shape,
             interpret=interpret,
-        )(starts, lens, shx, shy, shz, ncells, boxl, ioff, *i_fields,
-          j_packed)
+        )(starts, lens, shx, shy, shz, ncells, boxl, ioff, aself,
+          *i_fields, j_packed)
         return outs
 
     return call

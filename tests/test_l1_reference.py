@@ -97,7 +97,8 @@ def test_noh_reference_config():
     rho0_actual = 1.0 / (4.0 * np.pi / 3.0 * 0.5**3)  # mTotal / V_sphere
     l1_norm = l1_error(fields["rho"] / rho0_actual, sol["rho"])
     assert l1_norm < 2.5, l1_norm
-    # post-shock plateau forms ((gamma+1)/(gamma-1))^3 * rho0 = 64 * 1.91;
-    # smoothed at 50^3 — assert > half the analytic jump
-    assert fields["rho"].max() > 0.5 * 64.0 * rho0_actual / 2.0
+    # post-shock plateau: analytic jump ((gamma+1)/(gamma-1))^3 = 64x
+    # over the actual mean density; measured peak 54.4 = ~45% of it at
+    # 50^3 smoothing — guard at 40%
+    assert fields["rho"].max() > 0.4 * 64.0 * rho0_actual
     assert drift < 1e-3, drift

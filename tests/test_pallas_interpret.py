@@ -170,3 +170,39 @@ def test_ve_pipeline_matches_xla_interpret(case, av_clean):
             err_msg=name,
         )
     assert float(me1[4]) == pytest.approx(float(me0[4]), rel=1e-4)
+
+
+def test_gravity_p2p_pallas_matches_xla_interpret():
+    """Streamed near-field P2P (gravity/traversal._pallas_p2p) vs the XLA
+    gather formulation, both through compute_gravity."""
+    import dataclasses
+
+    from sphexa_tpu.gravity.traversal import (
+        GravityConfig,
+        compute_gravity,
+        estimate_gravity_caps,
+    )
+    from sphexa_tpu.gravity.tree import build_gravity_tree
+    from sphexa_tpu.init import init_evrard
+    from sphexa_tpu.sfc.box import make_global_box
+
+    state, box, const = init_evrard(16)
+    box = make_global_box(state.x, state.y, state.z, box)
+    ss, keys, _ = _sort_by_keys(state, box, "hilbert")
+    gtree, meta = build_gravity_tree(np.asarray(keys), bucket_size=64)
+    cfg0 = estimate_gravity_caps(
+        ss.x, ss.y, ss.z, ss.m, keys, box, gtree, meta,
+        GravityConfig(theta=0.5, G=1.0),
+    )
+    out0 = compute_gravity(
+        ss.x, ss.y, ss.z, ss.m, ss.h, keys, box, gtree, meta, cfg0
+    )
+    cfg1 = dataclasses.replace(cfg0, use_pallas=True)
+    out1 = compute_gravity(
+        ss.x, ss.y, ss.z, ss.m, ss.h, keys, box, gtree, meta, cfg1
+    )
+    for name, a, b in zip(("ax", "ay", "az", "egrav"), out1[:4], out0[:4]):
+        sa, sb = np.asarray(a), np.asarray(b)
+        scale = np.max(np.abs(sb)) + 1e-12
+        np.testing.assert_allclose(sa, sb, atol=1e-6 * scale, rtol=1e-4,
+                                   err_msg=name)
