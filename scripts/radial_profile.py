@@ -1,0 +1,95 @@
+#!/usr/bin/env python3
+"""Radial profile of a dump field (reference scripts/radial_profile.py).
+
+Usage: python scripts/radial_profile.py dump.h5 [-s STEP] [-f rho] [--bins N]
+       python scripts/radial_profile.py dump.h5 --list
+
+Prints a two-column (r, mean) table to stdout; pass --png out.png to plot
+instead (matplotlib optional).
+"""
+
+import os
+import sys
+from argparse import ArgumentParser
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def list_steps(fname):
+    import h5py
+
+    with h5py.File(fname, "r") as f:
+        print(f"{fname} contains the following steps:")
+        print(f"{'hdf5 step':>12} {'iteration':>12} {'time':>15}")
+        for k in sorted(
+            (k for k in f.keys() if k.startswith("Step#")),
+            key=lambda k: int(k.split("#")[1]),
+        ):
+            g = f[k]
+            print(f"{k.split('#')[1]:>12} "
+                  f"{int(np.asarray(g.attrs.get('iteration', 0))):>12} "
+                  f"{float(np.asarray(g.attrs.get('time', 0.0))):>15.6g}")
+
+
+def main(argv=None) -> int:
+    ap = ArgumentParser()
+    ap.add_argument("file")
+    ap.add_argument("-s", "--step", type=int, default=-1)
+    ap.add_argument("-f", "--field", default="rho")
+    ap.add_argument("--bins", type=int, default=60)
+    ap.add_argument("--png", default=None)
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        list_steps(args.file)
+        return 0
+
+    import h5py
+
+    from sphexa_tpu.analysis.evrard import radial_profile
+
+    with h5py.File(args.file, "r") as f:
+        steps = sorted(
+            (int(k.split("#")[1]) for k in f.keys() if k.startswith("Step#"))
+        )
+        step = steps[args.step] if args.step < 0 else args.step
+        g = f[f"Step#{step}"]
+        if args.field not in g:
+            print(f"field {args.field!r} not in Step#{step}; available: "
+                  f"{sorted(g.keys())}", file=sys.stderr)
+            return 1
+        x = np.asarray(g["x"])
+        y = np.asarray(g["y"])
+        z = np.asarray(g["z"])
+        v = np.asarray(g[args.field])
+        t = float(np.asarray(g.attrs.get("time", 0.0)))
+
+    r = np.sqrt(x * x + y * y + z * z)
+    prof = radial_profile(r, v, bins=args.bins)
+    if args.png:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        plt.scatter(r, v, s=0.1, label="particles")
+        plt.plot(prof["r"], prof["mean"], color="C1", label="binned mean")
+        plt.xlabel("r")
+        plt.ylabel(args.field)
+        plt.title(f"{args.field} at t={t:.5g} (Step#{step})")
+        plt.legend()
+        plt.savefig(args.png)
+        print(f"wrote {args.png}")
+    else:
+        print(f"# {args.field} radial profile, Step#{step}, t={t:.6g}")
+        for rr, vv, cc in zip(prof["r"], prof["mean"], prof["count"]):
+            if cc > 0:
+                print(f"{rr:.6g} {vv:.6g}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""2-D slice of a dump field (reference scripts/slice.py).
+
+Usage: python scripts/slice.py dump.h5 [-s STEP] [-f rho] [--axis z]
+       [--coord 0.0] [--png out.png]
+
+Selects particles within half a smoothing length of the slicing plane and
+prints (or plots with --png) the in-plane scatter colored by the field.
+"""
+
+import os
+import sys
+from argparse import ArgumentParser
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = ArgumentParser()
+    ap.add_argument("file")
+    ap.add_argument("-s", "--step", type=int, default=-1)
+    ap.add_argument("-f", "--field", default="rho")
+    ap.add_argument("--axis", choices=("x", "y", "z"), default="z")
+    ap.add_argument("--coord", type=float, default=0.0,
+                    help="plane position along --axis")
+    ap.add_argument("--png", default=None)
+    args = ap.parse_args(argv)
+
+    import h5py
+
+    with h5py.File(args.file, "r") as f:
+        steps = sorted(
+            (int(k.split("#")[1]) for k in f.keys() if k.startswith("Step#"))
+        )
+        step = steps[args.step] if args.step < 0 else args.step
+        g = f[f"Step#{step}"]
+        if args.field not in g:
+            print(f"field {args.field!r} not in Step#{step}; available: "
+                  f"{sorted(g.keys())}", file=sys.stderr)
+            return 1
+        data = {k: np.asarray(g[k]) for k in ("x", "y", "z", "h")}
+        v = np.asarray(g[args.field])
+        t = float(np.asarray(g.attrs.get("time", 0.0)))
+
+    normal = data[args.axis]
+    keep = np.abs(normal - args.coord) < 0.5 * data["h"]
+    in_plane = [a for a in ("x", "y", "z") if a != args.axis]
+    u, w = data[in_plane[0]][keep], data[in_plane[1]][keep]
+    vv = v[keep]
+    if args.png:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        sc = plt.scatter(u, w, c=vv, s=1.0, cmap="viridis")
+        plt.colorbar(sc, label=args.field)
+        plt.xlabel(in_plane[0])
+        plt.ylabel(in_plane[1])
+        plt.title(f"{args.field} slice {args.axis}={args.coord} "
+                  f"t={t:.5g} (Step#{step})")
+        plt.gca().set_aspect("equal")
+        plt.savefig(args.png, dpi=150)
+        print(f"wrote {args.png} ({keep.sum()} particles)")
+    else:
+        print(f"# {args.field} slice {args.axis}={args.coord}, Step#{step}, "
+              f"t={t:.6g}, {keep.sum()} particles")
+        for a, b, c in zip(u, w, vv):
+            print(f"{a:.6g} {b:.6g} {c:.6g}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Per-iteration timing breakdown from a --profile run
+(reference scripts/substep_timings.py, stacked-bar phase plot).
+
+Usage: python scripts/substep_timings.py profile.npz [--png out.png]
+
+Without --png, prints per-phase totals/means; with it, draws the stacked
+per-iteration bars.
+"""
+
+import sys
+from argparse import ArgumentParser
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = ArgumentParser()
+    ap.add_argument("file", nargs="?", default="profile.npz")
+    ap.add_argument("--png", default=None)
+    args = ap.parse_args(argv)
+
+    data = np.load(args.file)
+    phases = [k for k in data.files if k != "iteration"]
+    iters = data["iteration"] if "iteration" in data.files else np.arange(
+        len(data[phases[0]])
+    )
+    if not phases:
+        print(f"{args.file} holds no phase series", file=sys.stderr)
+        return 1
+
+    if args.png:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        bottom = np.zeros(len(iters))
+        for k in phases:
+            v = np.nan_to_num(data[k])
+            plt.bar(iters, v, bottom=bottom, label=k, width=1.0)
+            bottom += v
+        plt.xlabel("iteration")
+        plt.ylabel("seconds")
+        plt.legend()
+        plt.title("per-iteration phase timings")
+        plt.savefig(args.png, dpi=150)
+        print(f"wrote {args.png}")
+        return 0
+
+    print(f"# {args.file}: {len(iters)} iterations")
+    print(f"{'phase':>14} {'total[s]':>10} {'mean[ms]':>10} {'max[ms]':>10}")
+    for k in phases:
+        v = np.nan_to_num(data[k])
+        print(f"{k:>14} {v.sum():>10.3f} {v.mean()*1e3:>10.2f} "
+              f"{v.max()*1e3:>10.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,8 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--G", type=float, default=None, dest="grav_constant",
                    help="gravitational constant override (enables gravity)")
     p.add_argument("--glass", default=None,
-                   help="glass template file (accepted for compatibility; a "
-                        "procedural jittered lattice is used instead)")
+                   help="glass template HDF5 file, tiled into every "
+                        "lattice-based IC (init/utils.hpp glass blocks); "
+                        "without it a procedural jittered lattice is used")
     p.add_argument("--wextra", default="",
                    help="comma-separated extra output triggers: integers = "
                         "iterations, floats = simulation times")
@@ -86,10 +87,19 @@ def main(argv=None) -> int:
     if settings_path is not None:
         import json
 
-        with open(settings_path) as f:
-            case_overrides = json.load(f)
+        try:
+            with open(settings_path) as f:
+                case_overrides = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read settings file {settings_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not isinstance(case_overrides, dict):
+            print(f"{settings_path} must hold a JSON object", file=sys.stderr)
+            return 2
     is_restart = args.init not in CASES and looks_like_file(args.init)
     turb_state, turb_cfg, restart_iteration = None, None, 0
+    chem_restored = None
     if is_restart:
         from sphexa_tpu.io.snapshot import read_snapshot_full
 
@@ -102,6 +112,18 @@ def main(argv=None) -> int:
             if "initCase" in attrs
             else ""
         )
+        if case_overrides is None and "caseSettings" in attrs:
+            # threshold-bearing observables (e.g. WindBubble) must see the
+            # same overrides the original run used
+            import json
+
+            case_overrides = json.loads(
+                np.asarray(attrs["caseSettings"]).item().decode()
+            )
+        if args.prop == "std-cooling" and "chem_hi" in extra:
+            from sphexa_tpu.physics.cooling import chemistry_from_fields
+
+            chem_restored = chemistry_from_fields(extra)
         if args.prop == "turb-ve" and "turb_phases" in extra:
             # resume the OU stirring state + config (the reference
             # checkpoints phases + RNG the same way, turb_ve.hpp:88-97)
@@ -109,16 +131,27 @@ def main(argv=None) -> int:
 
             turb_state, turb_cfg = turbulence_state_from_fields(extra)
     else:
+        if args.glass:
+            from sphexa_tpu.init.glass import set_glass_template
+
+            try:
+                set_glass_template(args.glass)
+            except OSError as e:
+                print(f"cannot read glass template {args.glass}: {e}",
+                      file=sys.stderr)
+                return 2
+            log(f"# tiling glass template {args.glass}")
         try:
             initializer = make_initializer(args.init)
         except ValueError as e:
             print(str(e), file=sys.stderr)
             return 2
-        state, box, const = initializer(args.side)
+        try:
+            state, box, const = initializer(args.side)
+        finally:
+            if args.glass:
+                set_glass_template(None)
 
-    if args.glass:
-        log(f"# --glass {args.glass} noted: the TPU build generates an "
-            "equivalent procedural jittered-lattice block (init/glass.py)")
     if args.grav_constant is not None:
         # --G overrides the case's gravitational constant (sphexa.cpp --G)
         import dataclasses as _dc
@@ -131,7 +164,7 @@ def main(argv=None) -> int:
     observable = make_observable(case_name, overrides=case_overrides)
     sim = Simulation(state, box, const, prop=args.prop,
                      av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
-                     turb_state=turb_state, turb_cfg=turb_cfg,
+                     turb_state=turb_state, turb_cfg=turb_cfg, chem=chem_restored,
                      keep_fields=observable.needs_fields, theta=args.theta)
     log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
 
@@ -155,16 +188,21 @@ def main(argv=None) -> int:
     w_time = w if w > 0 and w_steps is None else None
     next_dump_time = [float(state.ttot) + w_time] if w_time else None
     if w > 0 or args.wextra:
-        case_tag = "".join(c if c.isalnum() else "_" for c in args.init)
+        # on restart, keep dumping under the ORIGINAL case's name (the
+        # reference appends Step#n to the restarted file) instead of a
+        # mangled snapshot-path tag that grows on every restart
+        tag_src = case_name if (is_restart and case_name) else args.init
+        case_tag = "".join(c if c.isalnum() else "_" for c in tag_src)
         ext = "txt" if args.ascii else "h5"
         dump_path = f"{args.out_dir}/dump_{case_tag}.{ext}"
-        # drop leftovers of a previous run (would interleave old steps)
+        # drop leftovers of a previous run (would interleave old steps);
+        # a restart instead APPENDS new Step#n groups to the existing dump
         import glob as _glob
 
         stale = (
             _glob.glob(f"{args.out_dir}/dump_{case_tag}_it*.txt")
             if args.ascii
-            else [dump_path] * os.path.exists(dump_path)
+            else [dump_path] * (os.path.exists(dump_path) and not is_restart)
         )
         for f in stale:
             print(f"# removing stale {f}", file=sys.stderr)
@@ -192,7 +230,10 @@ def main(argv=None) -> int:
     if not is_restart and os.path.exists(constants_path):
         print(f"# truncating stale {constants_path}", file=sys.stderr)
         os.remove(constants_path)
-    constants = ConstantsWriter(constants_path, observable)
+    constants = ConstantsWriter(
+        constants_path, observable,
+        restart_iteration=restart_iteration if is_restart else None,
+    )
 
     def output_fields():
         from sphexa_tpu.analysis import compute_output_fields
@@ -236,9 +277,14 @@ def main(argv=None) -> int:
                 **extra,
                 **turbulence_state_to_fields(sim.turb_state, sim.turb_cfg),
             }
+        if sim.chem is not None:
+            from sphexa_tpu.physics.cooling import chemistry_to_fields
+
+            extra = {**extra, **chemistry_to_fields(sim.chem)}
         step = write_snapshot(
             dump_path, sim.state, sim.box, const, iteration=it,
             extra_fields=extra, case=case_name,
+            case_settings=case_overrides,
         )
         log(f"# wrote Step#{step} -> {dump_path}")
 
@@ -257,8 +303,11 @@ def main(argv=None) -> int:
             due = True
         if not due:
             return
-        if next_dump_time is not None and t_now >= next_dump_time[0]:
-            next_dump_time[0] += w_time
+        if next_dump_time is not None:
+            # catch up across multi-interval steps: one dump, schedule
+            # advanced past t_now (not one redundant dump per interval)
+            while t_now >= next_dump_time[0]:
+                next_dump_time[0] += w_time
         dump_now(it)
 
     from sphexa_tpu.util.timer import ProfileRecorder, Timer

@@ -82,13 +82,23 @@ def make_initializer(name: str) -> Callable:
             raise ValueError(f"{settings_path} must hold a JSON object")
         return functools.partial(CASES[case], overrides=overrides)
 
-    from sphexa_tpu.init.file_init import init_from_file, looks_like_file
+    from sphexa_tpu.init.file_init import (
+        init_file_split,
+        init_from_file,
+        looks_like_file,
+        parse_split_spec,
+    )
 
+    split = parse_split_spec(name)
+    if split is not None and looks_like_file(split[0]):
+        # 'path,N' particle-split up-sampling (factory.hpp:101)
+        return functools.partial(init_file_split, split[0], split[1])
     if looks_like_file(name):
         return functools.partial(init_from_file, name)
     raise ValueError(
         f"unknown test case '{name}' (not a case name in {sorted(CASES)}, "
-        "not 'case:settings.json', not an existing snapshot file)"
+        "not 'case:settings.json', not 'file,N' splitting, and not an "
+        "existing snapshot file)"
     )
 
 

@@ -45,3 +45,105 @@ def init_from_file(
     path, step = parse_file_spec(spec)
     state, box, const, _extra = read_snapshot(path, step=step)
     return state, box, const
+
+
+def parse_split_spec(spec: str):
+    """Split 'path,N' (the reference's file-split grammar,
+    factory.hpp:101) -> (path, N) or None if the spec has no ',N'."""
+    path, sep, num = spec.rpartition(",")
+    if sep and path and _is_int(num) and int(num) >= 1:
+        return path, int(num)
+    return None
+
+
+def init_file_split(
+    path: str, num_splits: int, side: Optional[int] = None
+) -> Tuple[ParticleState, Box, SimConstants]:
+    """Up-sample a snapshot by an integer particle-split factor
+    (``--init dump.h5,N``; file_init.hpp FileSplitInit:105-246).
+
+    Each original particle spawns ``num_splits`` particles: itself plus
+    interpolated positions at evenly spaced SFC keys toward the next
+    particle's key (so the new particles fill the local key gap), with
+    m/N, h/N^(1/3) and all other fields replicated; the clock restarts
+    (iteration 1, ttot 0) and minDt is reduced by 100*N like the
+    reference.
+    """
+    import dataclasses
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from sphexa_tpu.dtypes import KEY_BITS, KEY_MAX
+    from sphexa_tpu.sfc.hilbert import hilbert_decode
+    from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+    if num_splits < 1:
+        raise ValueError(
+            f"number of particle splits must be a positive integer "
+            f"(got {num_splits})"
+        )
+    state, box, const, _extra = read_snapshot(path, step=-1)
+    n0 = state.n
+
+    keys = np.asarray(
+        compute_sfc_keys(state.x, state.y, state.z, box), dtype=np.uint64
+    )
+    order = np.argsort(keys)
+    keys = keys[order]
+
+    def sorted_np(a):
+        return np.asarray(a)[order]
+
+    x0, y0, z0 = sorted_np(state.x), sorted_np(state.y), sorted_np(state.z)
+
+    # interpolated SFC keys between consecutive particles
+    # (file_init.hpp:184-195: the last particle interpolates backward)
+    key_next = np.empty_like(keys)
+    key_next[:-1] = keys[1:]
+    key_next[-1] = keys[-1] - (keys[-1] - keys[-2]) if n0 > 1 else keys[-1]
+    denom = np.full(n0, num_splits, dtype=np.int64)
+    denom[-1] += 1
+    delta = (key_next.astype(np.int64) - keys.astype(np.int64)) // denom
+
+    n1 = n0 * num_splits
+    xs = np.empty(n1, np.float32)
+    ys = np.empty(n1, np.float32)
+    zs = np.empty(n1, np.float32)
+    xs[::num_splits], ys[::num_splits], zs[::num_splits] = x0, y0, z0
+    lo = np.asarray([float(box.lo[0]), float(box.lo[1]), float(box.lo[2])])
+    lengths = np.asarray(box.lengths)
+    max_coord = float(1 << KEY_BITS)
+    for j in range(1, num_splits):
+        kj = (keys.astype(np.int64) + j * delta).astype(np.uint64)
+        ix, iy, iz = hilbert_decode(jnp.asarray(kj, dtype=jnp.uint32))
+        xs[j::num_splits] = lo[0] + np.asarray(ix) * lengths[0] / max_coord
+        ys[j::num_splits] = lo[1] + np.asarray(iy) * lengths[1] / max_coord
+        zs[j::num_splits] = lo[2] + np.asarray(iz) * lengths[2] / max_coord
+
+    def replicate(field, scale=1.0):
+        return np.repeat(sorted_np(field) * scale, num_splits)
+
+    inv_cbrt = float(num_splits) ** (-1.0 / 3.0)
+    min_dt = float(state.min_dt) / (100.0 * num_splits)
+    vx = replicate(state.vx)
+    vy = replicate(state.vy)
+    vz = replicate(state.vz)
+    new_state = dataclasses.replace(
+        state,
+        x=jnp.asarray(xs), y=jnp.asarray(ys), z=jnp.asarray(zs),
+        vx=jnp.asarray(vx), vy=jnp.asarray(vy), vz=jnp.asarray(vz),
+        m=jnp.asarray(replicate(state.m, 1.0 / num_splits)),
+        h=jnp.asarray(replicate(state.h, inv_cbrt)),
+        temp=jnp.asarray(replicate(state.temp)),
+        alpha=jnp.asarray(replicate(state.alpha)),
+        du=jnp.zeros(n1, jnp.float32),
+        du_m1=jnp.zeros(n1, jnp.float32),
+        x_m1=jnp.asarray(vx * min_dt),
+        y_m1=jnp.asarray(vy * min_dt),
+        z_m1=jnp.asarray(vz * min_dt),
+        ttot=jnp.float32(0.0),
+        min_dt=jnp.float32(min_dt),
+        min_dt_m1=jnp.float32(min_dt),
+    )
+    return new_state, box, const

@@ -21,7 +21,13 @@ def jittered_lattice(
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Jittered lattice with ``counts=(nx,ny,nz)`` points spanning the cuboid
     [lo, hi) — the generator form of assembleCuboid (grid.hpp:201) for
-    anisotropic boxes (thin slabs, multi-layer setups)."""
+    anisotropic boxes (thin slabs, multi-layer setups).
+
+    When a glass template is installed (``set_glass_template``, the CLI's
+    --glass flag), the template is tiled instead — every built-in case
+    then gets the relaxed glass IC exactly like the reference factory."""
+    if _ACTIVE_TEMPLATE is not None:
+        return assemble_glass_cuboid(_ACTIVE_TEMPLATE, lo, hi, counts)
     rng = np.random.default_rng(seed)
     lo = np.asarray(lo, np.float64)
     hi = np.asarray(hi, np.float64)
@@ -91,3 +97,71 @@ def compress_center_cube(x, y, z, r_int: float, s: float, r_ext: float, eps=0.0)
         inner, r_int / s, capped_pyramid_stretch(x, y, z, r_int, s, r_ext)
     )
     return x * scale, y * scale, z * scale
+
+
+# --- glass-block templates (utils.hpp readTemplateBlock + grid.hpp
+# assembleCuboid): an externally relaxed particle block, tiled to the
+# requested resolution. The CLI's --glass flag installs one globally
+# (matching the reference, where the template applies to whichever case
+# is initialized); when none is installed the procedural jittered
+# lattice above is used.
+
+_ACTIVE_TEMPLATE = None
+
+
+def read_template_block(path: str):
+    """Read the x/y/z template coordinates from an HDF5 file (either a
+    dump with Step#n groups or flat root datasets) and normalize them to
+    [0, 1)^3 (readTemplateBlock, main/src/init/utils.hpp:73-86)."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        steps = sorted(
+            (k for k in f.keys() if k.startswith("Step#")),
+            key=lambda k: int(k.split("#")[1]),
+        )
+        g = f[steps[-1]] if steps else f
+        x = np.asarray(g["x"], np.float64)
+        y = np.asarray(g["y"], np.float64)
+        z = np.asarray(g["z"], np.float64)
+    out = []
+    for v in (x, y, z):
+        lo, hi = v.min(), v.max()
+        extent = max(hi - lo, 1e-30)
+        # map into [0,1) with a half-spacing margin so tiled copies don't
+        # produce coincident points at tile faces
+        n_lin = max(len(v) ** (1.0 / 3.0), 2.0)
+        out.append((v - lo) / extent * (1.0 - 1.0 / n_lin) + 0.5 / n_lin)
+    return tuple(out)
+
+
+def set_glass_template(path):
+    """Install (or clear, with None) the global glass template consulted
+    by ``jittered_lattice``."""
+    global _ACTIVE_TEMPLATE
+    _ACTIVE_TEMPLATE = read_template_block(path) if path else None
+
+
+def assemble_glass_cuboid(template, lo, hi, counts):
+    """Tile the normalized template into [lo, hi) with per-dimension
+    multiplicity chosen to approximate ``counts`` particles
+    (assembleCuboid, grid.hpp:201; multiplicity rule factory-side,
+    noh_init.hpp:127-129)."""
+    tx, ty, tz = template
+    b_lin = max(len(tx) ** (1.0 / 3.0), 1.0)
+    lo = np.asarray(lo, np.float64)
+    hi = np.asarray(hi, np.float64)
+    mx, my, mz = (max(1, int(np.rint(c / b_lin))) for c in counts)
+    # full 3-D tiling via broadcasting: (mx, my, mz, B) tile offsets
+    ox = np.arange(mx)[:, None, None, None]
+    oy = np.arange(my)[None, :, None, None]
+    oz = np.arange(mz)[None, None, :, None]
+    X = lo[0] + (tx[None, None, None, :] + ox) * ((hi[0] - lo[0]) / mx)
+    Y = lo[1] + (ty[None, None, None, :] + oy) * ((hi[1] - lo[1]) / my)
+    Z = lo[2] + (tz[None, None, None, :] + oz) * ((hi[2] - lo[2]) / mz)
+    X, Y, Z = np.broadcast_arrays(X, Y, Z)
+    return (
+        np.ascontiguousarray(X.ravel()),
+        np.ascontiguousarray(Y.ravel()),
+        np.ascontiguousarray(Z.ravel()),
+    )

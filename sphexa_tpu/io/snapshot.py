@@ -78,6 +78,7 @@ def write_snapshot(
     iteration: int = 0,
     extra_fields: Optional[Dict[str, np.ndarray]] = None,
     case: str = "",
+    case_settings: Optional[Dict] = None,
 ) -> int:
     """Append one restartable snapshot; returns the step index written.
 
@@ -93,6 +94,13 @@ def write_snapshot(
     attrs = _step_attrs(state, box, const, iteration)
     if case:
         attrs["initCase"] = np.bytes_(case)
+    if case_settings:
+        # the applied case-settings overrides ride along so a restart can
+        # rebuild threshold-bearing observables identically (the reference
+        # writes its init settings as file attributes, settings.hpp:45-57)
+        import json
+
+        attrs["caseSettings"] = np.bytes_(json.dumps(case_settings))
 
     if _is_h5(path):
         if not _HAVE_H5PY:

@@ -102,12 +102,30 @@ class ConstantsWriter:
     """Append one observable row per iteration to constants.txt
     (iobservables.hpp / fileutils::writeColumns)."""
 
-    def __init__(self, path: str, observable=None):
+    def __init__(self, path: str, observable=None, restart_iteration=None):
         self.path = path
         self.observable = observable or TimeAndEnergy()
         # appending to an existing file (restart) must not inject a second
         # header line mid-file
         self._wrote_header = os.path.exists(path) and os.path.getsize(path) > 0
+        if restart_iteration is not None and self._wrote_header:
+            self._truncate_after(restart_iteration)
+
+    def _truncate_after(self, iteration: int):
+        """Drop rows with iteration > the restart point, so resuming from
+        an older snapshot (--init dump.h5:-2) leaves a monotonic series
+        instead of overlapping row ranges."""
+        with open(self.path) as f:
+            lines = f.readlines()
+        kept = [
+            ln for ln in lines
+            if ln.startswith("#")
+            or not ln.strip()
+            or float(ln.split()[0]) <= iteration
+        ]
+        if len(kept) != len(lines):
+            with open(self.path, "w") as f:
+                f.writelines(kept)
 
     def write(
         self,

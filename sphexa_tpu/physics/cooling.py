@@ -235,3 +235,23 @@ def eos_cooling(rho_code, u_code, chem: ChemistryData, cfg: CoolingConfig):
 
     del chem  # composition-independent under the CIE closure
     return ideal_gas_eos_u(u_code, rho_code, cfg.gamma)
+
+
+_CHEM_FIELDS = ("hi", "hii", "hei", "heii", "heiii", "e", "metal")
+
+
+def chemistry_to_fields(chem: ChemistryData):
+    """Flatten the chemistry pytree into snapshot datasets (prefixed
+    ``chem_``), the checkpoint counterpart of the reference's per-particle
+    GRACKLE fields (std_hydro_grackle.hpp:89-106)."""
+    import numpy as np
+
+    return {f"chem_{k}": np.asarray(getattr(chem, k)) for k in _CHEM_FIELDS}
+
+
+def chemistry_from_fields(extra) -> ChemistryData:
+    """Rebuild ChemistryData from snapshot datasets written by
+    ``chemistry_to_fields``."""
+    return ChemistryData(
+        **{k: jnp.asarray(extra[f"chem_{k}"]) for k in _CHEM_FIELDS}
+    )
