@@ -1,6 +1,9 @@
 """Benchmark: particle-updates/sec/chip on the Sedov blast (driver contract).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+The headline metric is std SPH at Sedov BENCH_SIDE^3; "extra" carries the
+flagship VE pipeline and VE+gravity (Evrard) throughputs, so every
+pipeline the framework ships is pinned by the bench.
 
 Baseline: BASELINE.md's north star is Sedov 100^3 within 2x of sphexa-cuda
 per-chip throughput (16xA100 vs v5e-16). The reference publishes no absolute
@@ -20,19 +23,15 @@ BASELINE_UPDATES_PER_SEC = 2.0e7
 SIDE = int(os.environ.get("BENCH_SIDE", "100"))
 WARMUP = 2
 STEPS = int(os.environ.get("BENCH_STEPS", "10"))
+# auxiliary pipelines are timed at a smaller N to bound bench wall-clock
+# (VE ~2.5x the std step cost; gravity adds the tree solve)
+AUX_SIDE = int(os.environ.get("BENCH_AUX_SIDE", str(min(SIDE, 80))))
+AUX_STEPS = int(os.environ.get("BENCH_AUX_STEPS", "6"))
 
 
-def main() -> int:
+def _measure(sim, n, steps):
+    """Clean reconfigure-free window throughput (updates/s) or None."""
     import jax
-    from sphexa_tpu.init import init_sedov
-    from sphexa_tpu.simulation import Simulation
-
-    n = SIDE**3
-    state, box, const = init_sedov(SIDE)
-    # deferred cap-checking: the happy path issues no device->host sync
-    # per step (diagnostics checked in one batch at the window end)
-    sim = Simulation(state, box, const, prop="std", block=8192,
-                     check_every=STEPS)
 
     for _ in range(WARMUP):
         sim.step()
@@ -46,25 +45,68 @@ def main() -> int:
     tainted = d["reconfigured"] > 0.0
     for _attempt in range(3):
         t0 = time.perf_counter()
-        for _ in range(STEPS):
+        for _ in range(steps):
             sim.step()
         d = sim.flush()
         jax.block_until_ready(sim.state.x)
         elapsed = time.perf_counter() - t0
         if d["reconfigured"] == 0.0 and not tainted:
-            break
+            return n * steps / elapsed
         tainted = d["reconfigured"] > 0.0
-    else:
+    return None
+
+
+def main() -> int:
+    from sphexa_tpu.init import init_evrard, init_sedov
+    from sphexa_tpu.simulation import Simulation
+
+    n = SIDE**3
+    state, box, const = init_sedov(SIDE)
+    # deferred cap-checking: the happy path issues no device->host sync
+    # per step (diagnostics checked in one batch at the window end)
+    sim = Simulation(state, box, const, prop="std", block=8192,
+                     check_every=STEPS)
+    std_ups = _measure(sim, n, STEPS)
+    if std_ups is None:
         print("bench: no reconfigure-free window in 3 attempts", file=sys.stderr)
         return 1
-    updates_per_sec = n * STEPS / elapsed
+
+    extra = {}
+    try:
+        n_aux = AUX_SIDE**3
+        state, box, const = init_sedov(AUX_SIDE)
+        sim = Simulation(state, box, const, prop="ve", block=8192,
+                         check_every=AUX_STEPS)
+        ve_ups = _measure(sim, n_aux, AUX_STEPS)
+        if ve_ups:
+            extra["ve_updates_per_sec"] = round(ve_ups, 1)
+            extra["ve_side"] = AUX_SIDE
+            extra["ve_vs_baseline"] = round(ve_ups / BASELINE_UPDATES_PER_SEC, 4)
+    except Exception as e:  # aux lines must never sink the headline metric
+        print(f"bench: VE line failed: {e}", file=sys.stderr)
+    try:
+        state, box, const = init_evrard(AUX_SIDE)
+        sim = Simulation(state, box, const, prop="ve", block=8192,
+                         check_every=AUX_STEPS)
+        nev = int(state.n)
+        veg_ups = _measure(sim, nev, AUX_STEPS)
+        if veg_ups:
+            extra["ve_gravity_updates_per_sec"] = round(veg_ups, 1)
+            extra["ve_gravity_n"] = nev
+            extra["ve_gravity_vs_baseline"] = round(
+                veg_ups / BASELINE_UPDATES_PER_SEC, 4
+            )
+    except Exception as e:
+        print(f"bench: VE+gravity line failed: {e}", file=sys.stderr)
+
     print(
         json.dumps(
             {
                 "metric": f"particle-updates/sec/chip (Sedov {SIDE}^3, std SPH)",
-                "value": round(updates_per_sec, 1),
+                "value": round(std_ups, 1),
                 "unit": "particles/s",
-                "vs_baseline": round(updates_per_sec / BASELINE_UPDATES_PER_SEC, 4),
+                "vs_baseline": round(std_ups / BASELINE_UPDATES_PER_SEC, 4),
+                "extra": extra,
             }
         )
     )

@@ -55,6 +55,7 @@ def make_propagator_config(
     cell_target: int = 128,
     run_cap: int = 1536,
     gap: int = 384,
+    group: int = 64,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -98,7 +99,6 @@ def make_propagator_config(
     cap = pad_cap(native.max_cell_occupancy(keys[order], level))
     if min_cap > 0:
         cap = max(cap, pad_cap(min_cap))  # quantized so retry caps cache
-    group = 64  # targets per engine group (v5e sweep optimum)
     ncell = 1 << level
     ext = native.group_extents(xa, ya, za, order, group)
     # 10% radius slack absorbs drift between reconfigurations; a whole

@@ -35,7 +35,7 @@ gather rate, not FLOPs, limits throughput.
 """
 
 import functools
-from typing import Any, Callable, List, NamedTuple, Sequence, Tuple
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -65,6 +65,13 @@ class PairGeom(NamedTuple):
     rz: jax.Array
     d2: jax.Array     # squared distance
     mask: jax.Array   # valid pair: in-range candidate, within 2h_i, not self
+    # image-resolved j coordinates as (1, 128) rows — the per-j inputs of
+    # MXU feature blocks (see acc_widths). In fold mode these are the RAW
+    # (unfolded) j coordinates: per-pair minimum images cannot be expressed
+    # per-j, so MXU bodies must not be used with fold.
+    jx: jax.Array = None
+    jy: jax.Array = None
+    jz: jax.Array = None
 
 
 class GroupRanges(NamedTuple):
@@ -374,6 +381,33 @@ def pack_j_fields(fields: Sequence[jax.Array], cap: int) -> jax.Array:
     return flat.reshape(nf_pad, rows, 128).transpose(1, 0, 2)
 
 
+def chunk_aabb_table(x, y, z, cap: int) -> jax.Array:
+    """Per-128-chunk bounding boxes of the sorted coordinate arrays,
+    (rows, 128) f32 rows [lo_x, lo_y, lo_z, hi_x, hi_y, hi_z, 0...] — the
+    engine's chunk-cull input (row r covers sorted slots [128r, 128r+128)).
+    lane-padded to 128. Rows match pack_j_fields' padded row count; tail
+    rows get an empty (inverted) box so they never pass the cull."""
+    n = x.shape[0]
+    rows_n = -(-n // 128)
+    rows = rows_n + _dma_rows(cap)
+    pad = rows_n * 128 - n
+    def padded(a, fill):
+        a = jnp.concatenate([a, jnp.full((pad,), fill, a.dtype)]) if pad else a
+        return a.reshape(rows_n, 128)
+    BIG = jnp.float32(1e30)
+    lo = [jnp.min(padded(a, BIG), axis=1) for a in (x, y, z)]
+    hi = [jnp.max(padded(a, -BIG), axis=1) for a in (x, y, z)]
+    tbl = jnp.stack(lo + hi, axis=1)  # (rows_n, 6)
+    tail = jnp.tile(
+        jnp.asarray([[BIG, BIG, BIG, -BIG, -BIG, -BIG]], jnp.float32),
+        (rows - rows_n, 1),
+    )
+    tbl = jnp.concatenate([tbl, tail], axis=0)
+    # minor dim padded to the 128-lane tile (Mosaic DMAs cannot slice a
+    # narrower HBM minor dimension)
+    return jnp.pad(tbl, ((0, 0), (0, 122)))
+
+
 def pallas_interpret() -> bool:
     """Run Mosaic kernels in interpret mode off-TPU (single policy for
     every engine consumer — SPH ops, gravity, analysis)."""
@@ -391,6 +425,9 @@ def group_pair_engine(
     interpret: bool = False,
     num_slots: int = 0,
     pair_cutoff: bool = True,
+    chunk_skip: Optional[bool] = None,
+    acc_widths: Optional[Sequence[int]] = None,
+    want_nc: bool = True,
 ):
     """Build a pallas_call for one SPH pair op.
 
@@ -409,6 +446,19 @@ def group_pair_engine(
       window block, cfg.window**3; gravity passes its p2p cap instead).
     - ``pair_cutoff``: include the d2 < (2 h_i)^2 support test in the
       pair mask (SPH); gravity's near field keeps every ranged pair.
+    - ``chunk_skip``: cull whole 128-candidate chunks whose bbox misses
+      the group's inflated bbox (defaults to ``pair_cutoff and not
+      fold``); only meaningful for cutoff ops — gravity's near field has
+      no distance cutoff, so every chunk contributes.
+    - ``acc_widths``: per-accumulator lane width (default 128 for all).
+      A width of 128 is the classic lane-wise partial; a width F < 128
+      declares a (G, F) MXU accumulator — the pair body contracts the
+      chunk's lane dim itself (dot_general against a (F, 128) feature
+      block) and adds the (G, F) result, putting the j-reduction on the
+      MXU instead of the VPU.
+    - ``want_nc``: accumulate per-target neighbor counts (the trailing
+      output). Ops that ignore the counts pass False and save the
+      count's read-modify-write in every chunk.
     - returns fn(ranges, i_fields(NG,G) x num_i, j_packed, i_offset,
       allow_self) -> (outs (NG, G) x num_out, nc (NG, G)); ``allow_self``
       (traced bool) admits the self-index pair — replica-image passes of
@@ -417,14 +467,27 @@ def group_pair_engine(
     w3 = num_slots or cfg.window**3
     R = _dma_rows(cfg.dma_cap)
     nf_pad = _round_up(num_j, 8)
+    if chunk_skip is None:
+        # bitmask bits live in one int32, so the DMA window must fit 31
+        # chunks; beyond that (huge run_cap) the cull is simply skipped
+        chunk_skip = pair_cutoff and not fold and R <= 31
+    elif chunk_skip and R > 31:
+        raise ValueError(
+            f"chunk_skip needs a DMA window of <= 31 chunks (got {R}); "
+            "the per-run cull verdicts are bits of one int32"
+        )
+    if acc_widths is None:
+        acc_widths = (128,) * num_acc
 
     def kernel(*refs):
         starts, lens, shx_r, shy_r, shz_r, ncells, boxl, ioff, aself = refs[:9]
         i_refs = refs[9 : 9 + num_i]
         jref = refs[9 + num_i]
-        out_refs = refs[10 + num_i : -2]
+        nj_in = 11 + num_i if chunk_skip else 10 + num_i
+        aabb_ref = refs[10 + num_i] if chunk_skip else None
+        out_refs = refs[nj_in : -2]
         nc_ref = refs[-2]
-        buf, sems = refs[-1]  # unpacked below
+        (buf, sems, acc_refs, ncacc_ref, abuf, asems) = refs[-1]
 
         gi = pl.program_id(0)
         G = cfg.group
@@ -437,12 +500,27 @@ def group_pair_engine(
                 jref.at[pl.ds(row_s, R), :, :], buf.at[slot], sems.at[slot]
             )
 
+        def dma_aabb(w, slot):
+            row_s = starts[0, 0, w] // 128
+            return pltpu.make_async_copy(
+                aabb_ref.at[pl.ds(row_s, R), :], abuf.at[slot], asems.at[slot]
+            )
+
         @pl.when(nc_g > 0)
         def _():
             dma(0, 0).start()
+            if chunk_skip:
+                dma_aabb(0, 0).start()
 
         i_fields = [r[0, 0][:, None] for r in i_refs]  # (G, 1) each
         xi, yi, zi, hi = i_fields[:4]
+        # group bbox inflated by the search radius, for the per-chunk cull
+        # (recomputed from the i-fields already in VMEM — no new inputs);
+        # matches the prologue's cell cull exactly: radius = 2 * max h_i
+        if chunk_skip:
+            g_r = 2.0 * jnp.max(hi)
+            g_lo = (jnp.min(xi) - g_r, jnp.min(yi) - g_r, jnp.min(zi) - g_r)
+            g_hi = (jnp.max(xi) + g_r, jnp.max(yi) + g_r, jnp.max(zi) + g_r)
         # global index of the first target: shard offset + group offset
         # (candidate indices are GLOBAL sorted-array positions, so the
         # self-pair test must compare in global index space)
@@ -455,12 +533,13 @@ def group_pair_engine(
         lx, ly, lz = boxl[0, 0, 0], boxl[0, 0, 1], boxl[0, 0, 2]
 
         def cell_body(w, carry):
-            accs, nc_acc = carry
             slot = w % 2
 
             @pl.when(w + 1 < nc_g)
             def _():
                 dma(w + 1, 1 - slot).start()
+                if chunk_skip:
+                    dma_aabb(w + 1, 1 - slot).start()
 
             dma(w, slot).wait()
 
@@ -473,40 +552,85 @@ def group_pair_engine(
             off = s - row0 * 128
             nch = (off + ln + 127) // 128
 
-            def chunk_body(c, carry2):
-                accs, nc_acc = carry2
+            if chunk_skip:
+                # once-per-run chunk cull: compare every chunk's AABB row
+                # (DMAed alongside the j-fields) against the group's
+                # inflated bbox, pack the verdicts into ONE scalar bitmask;
+                # the chunk loop then tests a single bit per chunk instead
+                # of paying cross-lane reductions on the candidate data
+                dma_aabb(w, slot).wait()
+                ab = abuf[slot]  # (R, 128)
+                hit_rows = (
+                    (ab[:, 3:4] + shx >= g_lo[0]) & (ab[:, 0:1] + shx <= g_hi[0])
+                    & (ab[:, 4:5] + shy >= g_lo[1]) & (ab[:, 1:2] + shy <= g_hi[1])
+                    & (ab[:, 5:6] + shz >= g_lo[2]) & (ab[:, 2:3] + shz <= g_hi[2])
+                )  # (R, 1)
+                pow2 = jnp.left_shift(
+                    jnp.int32(1),
+                    jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0),
+                )
+                bits = jnp.sum(jnp.where(hit_rows, pow2, 0))
+
+            def chunk_math(c):
                 chunk = buf[slot, c]  # (nf_pad, 128)
                 j_fields = [chunk[f][None, :] for f in range(num_j)]
                 if fold:
                     # tiny-grid path: shifts are all zero, fold per pair
-                    rx = xi - j_fields[0]
-                    ry = yi - j_fields[1]
-                    rz = zi - j_fields[2]
+                    jx, jy, jz = j_fields[0], j_fields[1], j_fields[2]
+                    rx = xi - jx
+                    ry = yi - jy
+                    rz = zi - jz
                     rx = rx - lx * jnp.round(rx / lx)
                     ry = ry - ly * jnp.round(ry / ly)
                     rz = rz - lz * jnp.round(rz / lz)
                 else:
-                    rx = xi - (j_fields[0] + shx)
-                    ry = yi - (j_fields[1] + shy)
-                    rz = zi - (j_fields[2] + shz)
+                    jx = j_fields[0] + shx
+                    jy = j_fields[1] + shy
+                    jz = j_fields[2] + shz
+                    rx = xi - jx
+                    ry = yi - jy
+                    rz = zi - jz
                 d2 = rx * rx + ry * ry + rz * rz
                 cand = (row0 + c) * 128 + lane
                 mask = (cand >= s) & (cand < s + ln)
                 if pair_cutoff:
                     mask = mask & (d2 < h4)
                 mask = mask & ((cand != tgt_idx) | (aself[0, 0, 0] != 0))
-                geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
+                geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask,
+                                jx=jx, jy=jy, jz=jz)
+                # accumulators live in VMEM scratch (read-modify-write):
+                # a skipped chunk touches nothing, and the fori carries
+                # stay scalar so Mosaic never spills vector loop state
+                accs = tuple(r[...] for r in acc_refs)
                 accs = pair_body(geom, i_fields, j_fields, accs)
-                nc_acc = nc_acc + mask.astype(jnp.int32)
-                return accs, nc_acc
+                for r, a in zip(acc_refs, accs):
+                    r[...] = a
+                if want_nc:
+                    ncacc_ref[...] = ncacc_ref[...] + mask.astype(jnp.int32)
 
-            return jax.lax.fori_loop(0, nch, chunk_body, (accs, nc_acc))
+            def chunk_body(c, carry2):
+                if not chunk_skip:
+                    chunk_math(c)
+                    return carry2
 
-        acc0 = tuple(jnp.zeros((G, 128), jnp.float32) for _ in range(num_acc))
-        nc0 = jnp.zeros((G, 128), jnp.int32)
-        accs, nc_acc = jax.lax.fori_loop(0, nc_g, cell_body, (acc0, nc0))
+                # the chunk's AABB verdict is bit c of the run's bitmask —
+                # skipping the whole (G, 128) tile's pair math for
+                # gap-bridged / overshoot chunks costs one scalar test
+                @pl.when((jax.lax.shift_right_logical(bits, c) & 1) != 0)
+                def _():
+                    chunk_math(c)
 
-        nc_acc = jnp.sum(nc_acc, axis=1, keepdims=True)
+                return carry2
+
+            return jax.lax.fori_loop(0, nch, chunk_body, carry)
+
+        for r, wdt in zip(acc_refs, acc_widths):
+            r[...] = jnp.zeros((G, wdt), jnp.float32)
+        ncacc_ref[...] = jnp.zeros((G, 128), jnp.int32)
+        jax.lax.fori_loop(0, nc_g, cell_body, 0)
+        accs = tuple(r[...] for r in acc_refs)
+
+        nc_acc = jnp.sum(ncacc_ref[...], axis=1, keepdims=True)
         outs = finalize(i_fields, accs, nc_acc)
         for r, o in zip(out_refs, outs):
             r[0, 0] = o.reshape(G)
@@ -514,10 +638,21 @@ def group_pair_engine(
 
     def scalar_kernel(*refs):
         # scratch unpack shim: keep kernel() readable
-        kernel(*refs[:-2], (refs[-2], refs[-1]))
+        # buf, sems, accs x num_acc, nc[, aabb buf, aabb sems]
+        ns = num_acc + (5 if chunk_skip else 3)
+        buf, sems = refs[-ns], refs[-ns + 1]
+        if chunk_skip:
+            acc_refs = refs[-ns + 2 : -3]
+            kernel(*refs[:-ns],
+                   (buf, sems, acc_refs, refs[-3], refs[-2], refs[-1]))
+        else:
+            acc_refs = refs[-ns + 2 : -1]
+            kernel(*refs[:-ns], (buf, sems, acc_refs, refs[-1], None, None))
 
     def call(ranges: GroupRanges, i_fields: Sequence, j_packed,
-             i_offset=0, allow_self=False):
+             i_offset=0, allow_self=False, aabb=None):
+        if chunk_skip and aabb is None:
+            raise ValueError("chunk_skip engine needs the chunk AABB table")
         num_groups = ranges.num_groups
         ioff = jnp.asarray(i_offset, jnp.int32).reshape(1, 1, 1)
         aself = jnp.asarray(allow_self, jnp.int32).reshape(1, 1, 1)
@@ -534,7 +669,7 @@ def group_pair_engine(
         num_out_arrays = len(
             finalize(
                 [jnp.zeros((G, 1))] * num_i,
-                tuple(jnp.zeros((G, 1)) for _ in range(num_acc)),
+                tuple(jnp.zeros((G, w)) for w in acc_widths),
                 jnp.zeros((G, 1), jnp.int32),
             )
         )
@@ -562,7 +697,8 @@ def group_pair_engine(
                 pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
                 for _ in range(num_i)
             ]
-            + [pl.BlockSpec(memory_space=pl.ANY)],
+            + [pl.BlockSpec(memory_space=pl.ANY)]
+            + ([pl.BlockSpec(memory_space=pl.ANY)] if chunk_skip else []),
             out_specs=[
                 pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
                 for _ in range(num_out_arrays)
@@ -571,19 +707,27 @@ def group_pair_engine(
             scratch_shapes=[
                 pltpu.VMEM((2, R, nf_pad, 128), jnp.float32),
                 pltpu.SemaphoreType.DMA((2,)),
-            ],
+            ]
+            + [pltpu.VMEM((G, w), jnp.float32) for w in acc_widths]
+            + [pltpu.VMEM((G, 128), jnp.int32)]
+            + (
+                [pltpu.VMEM((2, R, 128), jnp.float32),
+                 pltpu.SemaphoreType.DMA((2,))]
+                if chunk_skip else []
+            ),
         )
         out_shape = [
             jax.ShapeDtypeStruct((num_groups, 1, G), jnp.float32)
             for _ in range(num_out_arrays)
         ] + [jax.ShapeDtypeStruct((num_groups, 1, G), jnp.int32)]
+        args = (starts, lens, shx, shy, shz, ncells, boxl, ioff, aself,
+                *i_fields, j_packed) + ((aabb,) if chunk_skip else ())
         outs = pl.pallas_call(
             scalar_kernel,
             grid_spec=grid_spec,
             out_shape=out_shape,
             interpret=interpret,
-        )(starts, lens, shx, shy, shz, ncells, boxl, ioff, aself,
-          *i_fields, j_packed)
+        )(*args)
         return outs
 
     return call
@@ -606,6 +750,16 @@ def _prep_i(x, y, z, h, extra_i, group: int = GROUP):
 # W on (G, 128) tiles from u = d2/h^2: 14 FMAs, no sqrt/sin/div
 # (shared evaluator — both backends compute identical W)
 _w_poly = sinc_poly_eval
+
+
+def _op_aabb(jfields: Sequence, box: Box, cfg: NeighborConfig):
+    """Chunk-AABB cull table for an op's j-side source arrays (None when
+    the engine runs without the cull: fold mode or oversized DMA window).
+    All ops of one step build it from the same coordinates inside one jit,
+    so XLA CSE collapses the copies."""
+    if engine_fold(box, cfg) or _dma_rows(cfg.dma_cap) > 31:
+        return None
+    return chunk_aabb_table(jfields[0], jfields[1], jfields[2], cfg.dma_cap)
 
 
 def pallas_density(
@@ -645,11 +799,13 @@ def pallas_density(
 
     engine = group_pair_engine(
         pair_body, finalize, num_i=6, num_j=4, num_acc=1, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret, chunk_skip=False,
     )
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m), cfg.group)
-    jp = pack_j_fields(jdata or (x, y, z, m), cfg.dma_cap)
-    rho, nc = engine(ranges, i_fields, jp, i_offset)
+    jf = jdata or (x, y, z, m)
+    jp = pack_j_fields(jf, cfg.dma_cap)
+    rho, nc = engine(ranges, i_fields, jp, i_offset,
+                     aabb=_op_aabb(jf, box, cfg))
     return rho.reshape(-1)[:n], nc.reshape(-1)[:n], ranges.occupancy
 
 
@@ -671,8 +827,9 @@ def pallas_iad(
 
     if ranges is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
+    fold = engine_fold(box, cfg)
 
-    def pair_body(geom, i_fields, j_fields, accs):
+    def pair_body_lanes(geom, i_fields, j_fields, accs):
         inv_h2 = i_fields[4]
         vj = j_fields[3]
         w = _w_poly(geom.d2 * inv_h2, coeffs)
@@ -684,10 +841,13 @@ def pallas_iad(
         return tuple(acc + t * vw for acc, t in zip(accs, terms))
 
     def finalize(i_fields, accs, nc):
-        hi = i_fields[3]
         t11, t12, t13, t22, t23, t33 = (
             jnp.sum(a, axis=1, keepdims=True) for a in accs
         )
+        return _invert(i_fields, t11, t12, t13, t22, t23, t33)
+
+    def _invert(i_fields, t11, t12, t13, t22, t23, t33):
+        hi = i_fields[3]
         # exponent renormalization (iad_kern.hpp ilogb/ldexp trick) via
         # exp2/log2 — exact because the factor cancels in adj/det
         exp_of = lambda v: jnp.where(
@@ -710,13 +870,20 @@ def pallas_iad(
             (t11 * t22 - t12 * t12) * factor,
         )
 
+    # NOTE: an MXU variant (second moments around the group center via one
+    # (G,128)x(128,16) dot_general per chunk, using the engine's acc_widths
+    # hook) measured SLOWER than the lane path on v5e (484 vs 434 ms/step,
+    # Sedov 100^3): the per-chunk NT-dot relayout exceeds the ~20 VPU ops
+    # it saves. Revisit if Mosaic grows a cheap lane-contraction.
     engine = group_pair_engine(
-        pair_body, finalize, num_i=5, num_j=4, num_acc=6, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret,
+        pair_body_lanes, finalize, num_i=5, num_j=4, num_acc=6, cfg=cfg,
+        fold=fold, interpret=interpret, chunk_skip=False, want_nc=False,
     )
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h),), cfg.group)
-    jp = pack_j_fields(jdata or (x, y, z, vol), cfg.dma_cap)
-    *cs, _nc = engine(ranges, i_fields, jp, i_offset)
+    jf = jdata or (x, y, z, vol)
+    jp = pack_j_fields(jf, cfg.dma_cap)
+    *cs, _nc = engine(ranges, i_fields, jp, i_offset,
+                      aabb=_op_aabb(jf, box, cfg))
     return tuple(c.reshape(-1)[:n] for c in cs), ranges.occupancy
 
 
@@ -813,7 +980,7 @@ def pallas_momentum_energy_std(
 
     engine = group_pair_engine(
         pair_body, finalize, num_i=18, num_j=17, num_acc=5, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
     )
     inv_h2 = 1.0 / (h * h)
     inv_h3 = inv_h2 / h
@@ -834,7 +1001,8 @@ def pallas_momentum_energy_std(
                    mj / (rhoj * hj * hj * hj), pj / rhoj,
                    j11, j12, j13, j22, j23, j33)
     jp = pack_j_fields(jfields, cfg.dma_cap)
-    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp, i_offset)
+    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp, i_offset,
+                                       aabb=_op_aabb(jfields, box, cfg))
     f = lambda a: a.reshape(-1)[:n]
     return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy
 
@@ -908,11 +1076,14 @@ def pallas_ve_def_gradh(
 
     engine = group_pair_engine(
         pair_body, finalize, num_i=7, num_j=5, num_acc=3, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret, chunk_skip=False,
+        want_nc=False,
     )
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m, xm), cfg.group)
-    jp = pack_j_fields((x, y, z, m, xm), cfg.dma_cap)
-    kx, gradh, _nc = engine(ranges, i_fields, jp)  # single-chip (no jdata yet)
+    jf = (x, y, z, m, xm)
+    jp = pack_j_fields(jf, cfg.dma_cap)
+    kx, gradh, _nc = engine(ranges, i_fields, jp,
+                            aabb=_op_aabb(jf, box, cfg))  # single-chip (no jdata yet)
     f = lambda a: a.reshape(-1)[:n]
     return (f(kx), f(gradh)), ranges.occupancy
 
@@ -995,7 +1166,7 @@ def pallas_iad_divv_curlv(
     engine = group_pair_engine(
         pair_body, finalize, num_i=15, num_j=7,
         num_acc=9 if with_gradv else 4, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
     )
     knorm = K / (h * h * h * kx)
     i_fields = _prep_i(
@@ -1003,8 +1174,9 @@ def pallas_iad_divv_curlv(
         (1.0 / (h * h), c11, c12, c13, c22, c23, c33, knorm, vx, vy, vz),
         cfg.group,
     )
-    jp = pack_j_fields((x, y, z, xm, vx, vy, vz), cfg.dma_cap)
-    *outs, _nc = engine(ranges, i_fields, jp)
+    jf = (x, y, z, xm, vx, vy, vz)
+    jp = pack_j_fields(jf, cfg.dma_cap)
+    *outs, _nc = engine(ranges, i_fields, jp, aabb=_op_aabb(jf, box, cfg))
     f = lambda a: a.reshape(-1)[:n]
     return tuple(f(a) for a in outs), ranges.occupancy
 
@@ -1079,7 +1251,7 @@ def pallas_av_switches(
 
     engine = group_pair_engine(
         pair_body, finalize, num_i=19, num_j=9, num_acc=4, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
     )
     # dt rides along as a constant i-field: one (1, 1, G) block DMA per
     # group (~256 B) — not worth a second engine scalar-operand mechanism
@@ -1090,8 +1262,9 @@ def pallas_av_switches(
          c11, c12, c13, c22, c23, c33, vx, vy, vz, alpha, dt_b),
         cfg.group,
     )
-    jp = pack_j_fields((x, y, z, c, vx, vy, vz, xm / kx, divv), cfg.dma_cap)
-    alpha_new, _nc = engine(ranges, i_fields, jp)
+    jf = (x, y, z, c, vx, vy, vz, xm / kx, divv)
+    jp = pack_j_fields(jf, cfg.dma_cap)
+    alpha_new, _nc = engine(ranges, i_fields, jp, aabb=_op_aabb(jf, box, cfg))
     return alpha_new.reshape(-1)[:n], ranges.occupancy
 
 
@@ -1237,7 +1410,7 @@ def pallas_momentum_energy_ve(
 
     engine = group_pair_engine(
         pair_body, finalize, num_i=NI, num_j=NJ, num_acc=6, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret,
+        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
     )
     inv_h2 = 1.0 / (h * h)
     inv_h3 = inv_h2 / h
@@ -1257,6 +1430,7 @@ def pallas_momentum_energy_ve(
         jfields = jfields + list(gradv)
     i_fields = _prep_i(x, y, z, h, tuple(extra_i), cfg.group)
     jp = pack_j_fields(tuple(jfields), cfg.dma_cap)
-    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp)
+    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp,
+                                       aabb=_op_aabb(jfields, box, cfg))
     f = lambda a: a.reshape(-1)[:n]
     return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy
