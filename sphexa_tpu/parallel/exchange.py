@@ -1,0 +1,232 @@
+"""Windowed halo exchange for the sharded Pallas fast path.
+
+TPU-native transposition of the reference's halo subsystem
+(cstone/halos/exchange_halos.hpp:43-119 pack-ranges -> p2p -> scatter,
+discovery cstone/traversal/collisions.hpp:26-106). The reference sends
+per-peer lists of octree-leaf row ranges; here each shard
+
+1. runs the shared group-window prologue on its OWN slab against the
+   GLOBAL cell-starts table (an O(ncells) psum of per-shard histograms —
+   the update_mpi.hpp allreduce analog, no key gather),
+2. derives, per source shard, the single row WINDOW [lo, hi) covering
+   every candidate run it needs from that shard (discovery),
+3. all_gathers the (P, P, 2) bounds matrix (the exchange_keys.hpp
+   negotiation analog — O(P^2) ints),
+4. receives the windows with ONE all_to_all of fixed (P, Wmax, nf)
+   buffers: shard j serves dynamic slices of its slab (pack), shard k
+   concatenates [own slab | annex] into the engine's j-buffer (scatter).
+
+Comm volume per shard = (P-1) * Wmax rows per exchange stage — the
+MEASURED candidate need (sized at reconfiguration, guarded in-step), not
+an unconditional O(N) replication. At CI scale (1e6 particles / 8 shards,
+level-4 cells) windows still span most of a slab — the halo *is* the
+volume at that granularity — but Wmax shrinks relative to the shard size
+as particles-per-shard grow (deeper grids, smaller surface fraction),
+which is the reference's scaling property (SURVEY.md §2e P2).
+
+A candidate run that escapes its source window (particle drift after the
+last sizing) zeroes itself and trips the step's occupancy sentinel; the
+CALLER owns recovery — discard the step and rebuild the sharded stepper
+with a larger ``halo_window`` (tests/test_parallel.py exercises both the
+sentinel and the resize), mirroring the neighbor-cap overflow contract.
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
+from sphexa_tpu.sph.pallas_pairs import GroupRanges
+
+INF32 = jnp.int32(2**30)
+
+
+def estimate_halo_window(
+    x, y, z, h, sorted_keys, box, nbr, P: int,
+    margin: float = 1.4, quantum: int = 1024,
+) -> int:
+    """Size the static per-peer window Wmax from the current particle
+    distribution (host-side, reconfiguration granularity — the halo
+    discovery analog of estimate_cell_cap). Runs the shared prologue on
+    the full arrays, clips candidate runs at slab boundaries, and returns
+    the padded max over (dest, src) pairs of the needed row span.
+    The in-step ``escaped`` guard remains the correctness backstop."""
+    import numpy as np
+
+    from sphexa_tpu.sph.pallas_pairs import group_cell_ranges
+
+    n = x.shape[0]
+    S = -(-n // P)
+    ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, nbr)
+    starts = np.asarray(ranges.starts)
+    lens = np.asarray(ranges.lens)
+    g = nbr.group
+    wmax = 1
+    for k in range(P):
+        g0 = k * S // g
+        g1 = min(((k + 1) * S + g - 1) // g, starts.shape[0])
+        st = starts[g0:g1].ravel()
+        ln = lens[g0:g1].ravel()
+        st, ln = st[ln > 0], ln[ln > 0]
+        for j in range(P):
+            if j == k:
+                continue
+            lo_j, hi_j = j * S, (j + 1) * S
+            ov = (st < hi_j) & (st + ln > lo_j)
+            if not ov.any():
+                continue
+            a = int(np.maximum(st[ov], lo_j).min())
+            b = int(np.minimum(st[ov] + ln[ov], hi_j).max())
+            wmax = max(wmax, b - a)
+    padded = int(-(-int(wmax * margin) // quantum) * quantum)
+    return min(padded, S)
+
+
+def global_cell_table(local_keys, level: int, axis: str) -> jax.Array:
+    """Cell-starts table of the level-``level`` grid over the DISTRIBUTED
+    key array: per-shard cid histogram -> psum -> exclusive cumsum.
+    O(ncells) comm; replicated result (update_mpi.hpp:26-106 role)."""
+    shift = KEY_DTYPE(3 * (KEY_BITS - level))
+    ncells = (1 << level) ** 3
+    cid = (local_keys >> shift).astype(jnp.int32)
+    hist = jnp.zeros(ncells, jnp.int32).at[cid].add(1)
+    hist = jax.lax.psum(hist, axis)
+    return jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(hist)]
+    ).astype(jnp.int32)
+
+
+def _split_runs(starts, lens, shifts3, S: int, extra: int = 8):
+    """Split candidate runs that cross shard-slab boundaries.
+
+    A run's rows must come from ONE source shard so it maps into one
+    annex window. Crossing runs (a window cell or merged run straddling
+    a multiple of S — at most P-1 cells globally) are clipped at the
+    boundary and the remainder pieces are appended as fresh runs;
+    everything is re-compacted front-first. Returns (starts, lens,
+    shifts3, nruns, overflow) with ``extra`` more slots per group.
+    """
+    ng, w3 = starts.shape
+    shx, shy, shz = shifts3
+    src0 = starts // S
+    src1 = jnp.where(lens > 0, (starts + lens - 1) // S, src0)
+    cross = (src1 > src0) & (lens > 0)
+    len1 = jnp.where(cross, (src0 + 1) * S - starts, lens)
+    # remainder pieces (zero-length when no crossing)
+    r_start = jnp.where(cross, (src0 + 1) * S, 0)
+    r_len = jnp.where(cross, lens - len1, 0)
+    # a remainder could itself cross (run longer than a whole slab):
+    # flagged as overflow — Wmax resizing cannot fix it, the caller must
+    # reduce run_cap below S (config error, not drift)
+    r_cross = jnp.any((r_len > 0) & ((r_start + r_len - 1) // S > r_start // S))
+
+    # compact the remainders of each group into `extra` slots
+    order = jnp.argsort(~(r_len > 0), axis=1, stable=True)[:, :extra]
+    take = lambda a: jnp.take_along_axis(a, order, axis=1)
+    e_start, e_len = take(r_start), take(r_len)
+    e_shx, e_shy, e_shz = take(shx), take(shy), take(shz)
+    overflow = jnp.sum(r_len > 0, axis=1) > extra
+
+    starts = jnp.concatenate([starts, e_start], axis=1)
+    lens = jnp.concatenate([jnp.where(cross, len1, lens), e_len], axis=1)
+    shx = jnp.concatenate([shx, e_shx], axis=1)
+    shy = jnp.concatenate([shy, e_shy], axis=1)
+    shz = jnp.concatenate([shz, e_shz], axis=1)
+
+    # re-compact: active runs to the front (stable keeps SFC order)
+    active = lens > 0
+    _, act_i, starts, lens, shx, shy, shz = jax.lax.sort(
+        ((~active).astype(jnp.int32), active.astype(jnp.int32),
+         starts, lens, shx, shy, shz),
+        num_keys=1, dimension=1, is_stable=True,
+    )
+    lens = jnp.where(act_i.astype(bool), lens, 0)
+    starts = jnp.where(act_i.astype(bool), starts, 0)
+    nruns = jnp.sum(active, axis=1).astype(jnp.int32)
+    return starts, lens, (shx, shy, shz), nruns, jnp.any(overflow) | r_cross
+
+
+def window_bounds(starts, lens, S: int, P: int, k, axis: str):
+    """Per-source-shard row windows needed by THIS shard, then the
+    all_gathered (P_dest, P_src, 2) bounds matrix (halo negotiation)."""
+    active = lens > 0
+    src = jnp.clip(starts // S, 0, P - 1)
+    ends = starts + lens
+    lo = jnp.full(P, INF32, jnp.int32)
+    hi = jnp.zeros(P, jnp.int32)
+    lo = lo.at[src].min(jnp.where(active, starts, INF32))
+    hi = hi.at[src].max(jnp.where(active, ends, 0))
+    # own slab is served locally, not through the annex
+    lo = lo.at[k].set(INF32)
+    hi = hi.at[k].set(0)
+    mine = jnp.stack([lo, hi], axis=1)  # (P, 2)
+    return mine, jax.lax.all_gather(mine, axis)  # (P, P, 2)
+
+
+def _effective_lo(bounds_all, S: int, Wmax: int, P: int):
+    """Deterministic serve offsets: clamp each window's lo into its
+    source slab so a fixed Wmax slice stays in range. Sender and
+    receiver evaluate the SAME formula on the replicated bounds."""
+    lo = bounds_all[:, :, 0]  # (P_dest, P_src)
+    srcs = jnp.arange(P, dtype=jnp.int32)[None, :]
+    return jnp.clip(lo, srcs * S, (srcs + 1) * S - Wmax)
+
+
+def serve_windows(fields: Sequence, bounds_all, S: int, Wmax: int,
+                  P: int, k, axis: str):
+    """One all_to_all exchange round: this shard serves every
+    destination's window out of its slab; returns the annex — (P, Wmax)
+    per field, row (j, i) holding global row lo_eff[k, j] + i."""
+    lo_eff = _effective_lo(bounds_all, S, Wmax, P)  # (P_dest, P_src)
+    local = jnp.stack(fields, axis=1)  # (S, nf)
+    nf = local.shape[1]
+
+    def serve_one(dest):
+        off = lo_eff[dest, k] - k * S
+        return jax.lax.dynamic_slice(local, (off, 0), (Wmax, nf))
+
+    send = jax.vmap(serve_one)(jnp.arange(P, dtype=jnp.int32))  # (P, Wmax, nf)
+    annex = jax.lax.all_to_all(send, axis, 0, 0, tiled=False)
+    annex = annex.reshape(P * Wmax, nf)
+    return [annex[:, f] for f in range(nf)]
+
+
+def localize_ranges(
+    ranges: GroupRanges, S: int, P: int, Wmax: int, k, axis: str,
+) -> Tuple[GroupRanges, jax.Array]:
+    """Rewrite a GLOBAL-row GroupRanges into j-buffer rows
+    [own slab (S) | annex (P * Wmax)] and produce the bounds matrix.
+
+    Runs outside their source's served window (drift since the last
+    Wmax sizing) zero out and flip the returned ``escaped`` flag, which
+    the caller folds into the occupancy sentinel.
+    """
+    starts, lens, sh3, nruns, split_ovf = _split_runs(
+        ranges.starts, ranges.lens,
+        (ranges.shift_x, ranges.shift_y, ranges.shift_z), S,
+    )
+    mine, bounds_all = window_bounds(starts, lens, S, P, k, axis)
+    lo_eff = _effective_lo(bounds_all, S, Wmax, P)[k]  # (P_src,)
+
+    src = jnp.clip(starts // S, 0, P - 1)
+    own = src == k
+    lo_run = lo_eff[src]
+    in_window = own | (
+        (starts >= lo_run) & (starts + lens <= lo_run + Wmax)
+    )
+    active = lens > 0
+    escaped = jnp.any(active & ~in_window) | split_ovf
+
+    local = jnp.where(
+        own, starts - k * S, S + src * Wmax + (starts - lo_run)
+    )
+    lens = jnp.where(active & in_window, lens, 0)
+    local = jnp.where(lens > 0, local, 0)
+
+    out = GroupRanges(
+        starts=local, lens=lens,
+        shift_x=sh3[0], shift_y=sh3[1], shift_z=sh3[2],
+        ncells=nruns, occupancy=ranges.occupancy, boxl=ranges.boxl,
+    )
+    return out, bounds_all, escaped

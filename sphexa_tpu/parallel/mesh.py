@@ -50,7 +50,8 @@ def shard_state(state, mesh: Mesh):
     return jax.tree.map(place, state)
 
 
-def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std):
+def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
+                      halo_window: int = 0):
     """Jit the full step with particle arrays sharded over the mesh.
 
     GSPMD partitions the entire program: the SFC sort's key exchange is the
@@ -71,7 +72,8 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std)
     # yet — those steps fall back to the GSPMD-partitioned XLA path.
     if cfg.backend == "pallas":
         if step_fn is step_hydro_std:
-            cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p")
+            cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p",
+                                      halo_window=halo_window)
         else:
             cfg = dataclasses.replace(cfg, backend="xla")
     if cfg.gravity is not None and cfg.gravity.use_pallas:
@@ -87,7 +89,7 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std)
 
     rspec = NamedSharding(mesh, P())
 
-    def stepper(s, b, gtree=None):
+    def inner(s, b, gtree=None):
         new_state, new_box, diag = step_fn(s, b, cfg, gtree)
         # keep the particle arrays sharded on the way out so the next step
         # starts from slab-owned arrays (no silent replication creep)...
@@ -106,4 +108,13 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std)
 
     # inputs are placed by shard_state; GSPMD propagates those shardings
     # through the whole program, one compiled executable reused every step
-    return jax.jit(stepper)
+    jitted = jax.jit(inner)
+
+    def stepper(s, b, gtree=None):
+        # commit the box replicated BEFORE the first call: an uncommitted
+        # box on step 0 compiles a second executable variant, and on CPU
+        # meshes two variants' collective channels can collide mid-run
+        b = jax.device_put(b, rspec)
+        return jitted(s, b, gtree)
+
+    return stepper

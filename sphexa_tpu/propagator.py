@@ -69,10 +69,13 @@ class PropagatorConfig:
     backend: str = "xla"
     # multi-chip fast path: when set (with backend='pallas'), the pair-op
     # stage runs under shard_map over ``mesh`` — each device executes the
-    # Mosaic engine on its SFC slab, with all_gather supplying the j-side
-    # candidate arrays (the halo-exchange analog; see _std_forces_sharded)
+    # Mosaic engine on its SFC slab, with the windowed all_to_all halo
+    # exchange supplying the j-side candidates (parallel/exchange.py)
     mesh: Optional[object] = None
     shard_axis: Optional[str] = None
+    # per-peer halo window rows (Wmax). 0 = full peer slabs (the safe
+    # all_gather-equivalent); sized tighter by estimate_halo_window
+    halo_window: int = 0
 
 
 def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
@@ -179,57 +182,80 @@ def _integrate_and_finish(
 
 def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     """std pair-op stage under shard_map: per-device Mosaic kernels on the
-    device's SFC slab.
+    device's SFC slab, halos via the windowed all_to_all exchange.
 
     The arrays arrive GLOBALLY sorted and slab-sharded (the sort is the
-    domain redistribution, parallel/mesh.py). Each shard all_gathers the
-    j-side candidate fields over ICI — the role of the reference's
-    exchangeHalos calls between kernels (std_hydro.hpp:131-151), with the
-    whole sorted array standing in for the halo regions until a
-    cell-granular exchange replaces it — and runs the fused engine on its
-    local targets. Scalar guards/timesteps are pmax/pmin-reduced so every
-    shard returns identical values.
+    domain redistribution, parallel/mesh.py). The shared prologue runs on
+    the local slab against the psum-built global cell table; candidate
+    runs outside the slab are served by SFC-peer shards through per-peer
+    row windows (parallel/exchange.py — the exchangeHalos analog,
+    std_hydro.hpp:131-151). Freshly computed fields the next op reads on
+    the j side are re-exchanged over the SAME windows, mirroring the
+    reference's per-stage halo choreography. Scalar guards/timesteps are
+    pmax/pmin-reduced so every shard returns identical values.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec
+    from sphexa_tpu.parallel import exchange as ex
     from sphexa_tpu.sph import pallas_pairs as pp
 
     axis = cfg.shard_axis
     const = cfg.const
     nbr = cfg.nbr
     interpret = _pallas_interpret()
+    P = cfg.mesh.shape[cfg.shard_axis]
+    S_shard = state.x.shape[0] // P
+    Wmax = min(cfg.halo_window, S_shard) or S_shard
+    # a merged run must fit in one source slab so the boundary split pass
+    # leaves at most one remainder per run (exchange._split_runs); a raw
+    # CELL wider than a slab still crosses and trips the split-overflow
+    # sentinel instead (pathological at any realistic shard size)
+    if nbr.run_cap > S_shard:
+        nbr = dataclasses.replace(nbr, run_cap=S_shard)
 
     def forces(box, keys, x, y, z, h, m, vx, vy, vz, temp):
-        ag = lambda a: jax.lax.all_gather(a, axis, tiled=True)
-        xg, yg, zg, hg, mg = ag(x), ag(y), ag(z), ag(h), ag(m)
-        keys_g = ag(keys)
-        i_offset = jax.lax.axis_index(axis) * x.shape[0]
+        S = x.shape[0]
+        k = jax.lax.axis_index(axis)
+        table = ex.global_cell_table(keys, nbr.level, axis)
+        granges = pp.group_cell_ranges(x, y, z, h, None, box, nbr,
+                                       table=table)
+        ranges, bounds, escaped = ex.localize_ranges(
+            granges, S, P, Wmax, k, axis
+        )
+        serve = lambda fields: ex.serve_windows(
+            fields, bounds, S, Wmax, P, k, axis
+        )
+        jbuf = lambda own, halo: tuple(
+            jnp.concatenate([o, a]) for o, a in zip(own, halo)
+        )
 
-        ranges = pp.group_cell_ranges(x, y, z, h, keys_g, box, nbr)
+        halo1 = serve((x, y, z, m))
         rho, nc, occ = pp.pallas_density(
-            x, y, z, h, m, keys_g, box, const, nbr, ranges=ranges,
-            jdata=(xg, yg, zg, mg), i_offset=i_offset, interpret=interpret,
+            x, y, z, h, m, None, box, const, nbr, ranges=ranges,
+            jdata=jbuf((x, y, z, m), halo1), interpret=interpret,
         )
         p, c = hydro_std.compute_eos_std(temp, rho, const)
-        # the freshly computed fields the next ops read on the j side are
-        # re-gathered — the exchangeHalos(rho, p, c) analog
-        rho_g = ag(rho)
-        # vol (5th arg) only feeds the j-side pack, which jdata replaces
-        # here — the candidate volumes are the GLOBAL mg / rho_g
+        halo2 = serve((m / rho,))
         cs, _ = pp.pallas_iad(
-            x, y, z, h, m / rho, keys_g, box, const, nbr, ranges=ranges,
-            jdata=(xg, yg, zg, mg / rho_g), i_offset=i_offset,
+            x, y, z, h, m / rho, None, box, const, nbr, ranges=ranges,
+            jdata=jbuf((x, y, z, m / rho), (halo1[0], halo1[1], halo1[2],
+                                            halo2[0])),
             interpret=interpret,
         )
-        vxg, vyg, vzg = ag(vx), ag(vy), ag(vz)
-        pg, cg = ag(p), ag(c)
-        cs_g = tuple(ag(a) for a in cs)
+        halo3 = serve((h, vx, vy, vz, rho, p, c, *cs))
         ax, ay, az, du, dt_c, _ = pp.pallas_momentum_energy_std(
             x, y, z, vx, vy, vz, h, m, rho, p, c, *cs,
-            keys_g, box, const, nbr, ranges=ranges,
-            jdata=(xg, yg, zg, hg, vxg, vyg, vzg, mg, rho_g, pg, cg, *cs_g),
-            i_offset=i_offset, interpret=interpret,
+            None, box, const, nbr, ranges=ranges,
+            jdata=jbuf((x, y, z, h, vx, vy, vz, m, rho, p, c, *cs),
+                       (halo1[0], halo1[1], halo1[2], halo3[0], halo3[1],
+                        halo3[2], halo3[3], halo1[3], halo3[4], halo3[5],
+                        halo3[6], *halo3[7:])),
+            interpret=interpret,
         )
+        # an escaped run means truncated candidates: fold into the
+        # occupancy sentinel (against the CALLER's cap — the local nbr may
+        # carry a clamped run_cap) so the driver re-sizes the halo window
+        occ = jnp.where(escaped, jnp.int32(cfg.nbr.cap + 1), occ)
         occ = jax.lax.pmax(occ, axis)
         dt_c = jax.lax.pmin(dt_c, axis)
         return rho, c, nc, occ, ax, ay, az, du, dt_c

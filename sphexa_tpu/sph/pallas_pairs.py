@@ -65,13 +65,6 @@ class PairGeom(NamedTuple):
     rz: jax.Array
     d2: jax.Array     # squared distance
     mask: jax.Array   # valid pair: in-range candidate, within 2h_i, not self
-    # image-resolved j coordinates as (1, 128) rows — the per-j inputs of
-    # MXU feature blocks (see acc_widths). In fold mode these are the RAW
-    # (unfolded) j coordinates: per-pair minimum images cannot be expressed
-    # per-j, so MXU bodies must not be used with fold.
-    jx: jax.Array = None
-    jy: jax.Array = None
-    jz: jax.Array = None
 
 
 class GroupRanges(NamedTuple):
@@ -109,7 +102,8 @@ def engine_fold(box: Box, cfg: NeighborConfig) -> bool:
 
 
 def group_cell_ranges(
-    x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig
+    x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig,
+    table=None,
 ) -> GroupRanges:
     """Candidate cells of every group, culled and compacted.
 
@@ -120,6 +114,14 @@ def group_cell_ranges(
     search radius 2*max(h). Survivors are compacted to the front so the
     kernel's cell loop trips only ``ncells`` times. ``occupancy`` encodes
     the cap AND window guards exactly like find_neighbors.
+
+    ``table``: optional externally built cell-starts table of the
+    level-``cfg.level`` grid, (ncell^3 + 1,) int32 of sorted-array
+    offsets. Under shard_map the table is GLOBAL (psum of per-shard cid
+    histograms, parallel/exchange.py) while x/y/z/h are the local slab:
+    the returned ranges are then global rows of the distributed array.
+    When given, ``sorted_keys`` may be None (the deep-grid searchsorted
+    fallback needs keys and is unavailable).
     """
     n = x.shape[0]
     level = cfg.level
@@ -172,15 +174,16 @@ def group_cell_ranges(
         lookup[..., 2].astype(KEY_DTYPE),
         bits=level,
     )
-    if ncell**3 <= 4 * max(n, 1024):
+    if table is not None or ncell**3 <= 4 * max(n, 1024):
         # ONE cell-starts table for the whole grid, then per-(group, cell)
         # range lookups are gathers from it — a binary search per window
         # cell into the N-element u64 key array costs ~20 emulated-u64
         # gathers each and dominated the prologue
-        cid = (sorted_keys >> shift).astype(jnp.int32)  # ascending
-        table = jnp.searchsorted(
-            cid, jnp.arange(ncell**3 + 1, dtype=jnp.int32)
-        ).astype(jnp.int32)
+        if table is None:
+            cid = (sorted_keys >> shift).astype(jnp.int32)  # ascending
+            table = jnp.searchsorted(
+                cid, jnp.arange(ncell**3 + 1, dtype=jnp.int32)
+            ).astype(jnp.int32)
         ck32 = ckey.astype(jnp.int32)
         start = table[ck32]
         end = table[ck32 + 1]
@@ -426,7 +429,6 @@ def group_pair_engine(
     num_slots: int = 0,
     pair_cutoff: bool = True,
     chunk_skip: Optional[bool] = None,
-    acc_widths: Optional[Sequence[int]] = None,
     want_nc: bool = True,
 ):
     """Build a pallas_call for one SPH pair op.
@@ -442,20 +444,14 @@ def group_pair_engine(
       outs is a tuple of (G,) arrays (f32), one per output.
     - ``num_i``/``num_j``: how many target/candidate fields the op reads
       (x, y, z are always fields 0-2 on both sides; h is i-field 3).
-    - ``num_slots``: width of the per-group range arrays (defaults to the
-      window block, cfg.window**3; gravity passes its p2p cap instead).
+    - ``num_slots``: unused (kept for call-site compatibility) — the
+      run-slot width is taken from the ranges arrays at call time.
     - ``pair_cutoff``: include the d2 < (2 h_i)^2 support test in the
       pair mask (SPH); gravity's near field keeps every ranged pair.
     - ``chunk_skip``: cull whole 128-candidate chunks whose bbox misses
       the group's inflated bbox (defaults to ``pair_cutoff and not
       fold``); only meaningful for cutoff ops — gravity's near field has
       no distance cutoff, so every chunk contributes.
-    - ``acc_widths``: per-accumulator lane width (default 128 for all).
-      A width of 128 is the classic lane-wise partial; a width F < 128
-      declares a (G, F) MXU accumulator — the pair body contracts the
-      chunk's lane dim itself (dot_general against a (F, 128) feature
-      block) and adds the (G, F) result, putting the j-reduction on the
-      MXU instead of the VPU.
     - ``want_nc``: accumulate per-target neighbor counts (the trailing
       output). Ops that ignore the counts pass False and save the
       count's read-modify-write in every chunk.
@@ -464,7 +460,6 @@ def group_pair_engine(
       (traced bool) admits the self-index pair — replica-image passes of
       periodic gravity need it.
     """
-    w3 = num_slots or cfg.window**3
     R = _dma_rows(cfg.dma_cap)
     nf_pad = _round_up(num_j, 8)
     if chunk_skip is None:
@@ -476,8 +471,6 @@ def group_pair_engine(
             f"chunk_skip needs a DMA window of <= 31 chunks (got {R}); "
             "the per-run cull verdicts are bits of one int32"
         )
-    if acc_widths is None:
-        acc_widths = (128,) * num_acc
 
     def kernel(*refs):
         starts, lens, shx_r, shy_r, shz_r, ncells, boxl, ioff, aself = refs[:9]
@@ -596,8 +589,7 @@ def group_pair_engine(
                 if pair_cutoff:
                     mask = mask & (d2 < h4)
                 mask = mask & ((cand != tgt_idx) | (aself[0, 0, 0] != 0))
-                geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask,
-                                jx=jx, jy=jy, jz=jz)
+                geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
                 # accumulators live in VMEM scratch (read-modify-write):
                 # a skipped chunk touches nothing, and the fori carries
                 # stay scalar so Mosaic never spills vector loop state
@@ -624,8 +616,8 @@ def group_pair_engine(
 
             return jax.lax.fori_loop(0, nch, chunk_body, carry)
 
-        for r, wdt in zip(acc_refs, acc_widths):
-            r[...] = jnp.zeros((G, wdt), jnp.float32)
+        for r in acc_refs:
+            r[...] = jnp.zeros((G, 128), jnp.float32)
         ncacc_ref[...] = jnp.zeros((G, 128), jnp.int32)
         jax.lax.fori_loop(0, nc_g, cell_body, 0)
         accs = tuple(r[...] for r in acc_refs)
@@ -654,6 +646,9 @@ def group_pair_engine(
         if chunk_skip and aabb is None:
             raise ValueError("chunk_skip engine needs the chunk AABB table")
         num_groups = ranges.num_groups
+        # run-slot width comes from the ranges themselves: the sharded
+        # path appends boundary-split slots beyond the window block
+        w3 = ranges.starts.shape[1]
         ioff = jnp.asarray(i_offset, jnp.int32).reshape(1, 1, 1)
         aself = jnp.asarray(allow_self, jnp.int32).reshape(1, 1, 1)
         smem3 = lambda a: a.reshape(num_groups, 1, w3)
@@ -669,7 +664,7 @@ def group_pair_engine(
         num_out_arrays = len(
             finalize(
                 [jnp.zeros((G, 1))] * num_i,
-                tuple(jnp.zeros((G, w)) for w in acc_widths),
+                tuple(jnp.zeros((G, 1)) for _ in range(num_acc)),
                 jnp.zeros((G, 1), jnp.int32),
             )
         )
@@ -708,7 +703,7 @@ def group_pair_engine(
                 pltpu.VMEM((2, R, nf_pad, 128), jnp.float32),
                 pltpu.SemaphoreType.DMA((2,)),
             ]
-            + [pltpu.VMEM((G, w), jnp.float32) for w in acc_widths]
+            + [pltpu.VMEM((G, 128), jnp.float32) for _ in range(num_acc)]
             + [pltpu.VMEM((G, 128), jnp.int32)]
             + (
                 [pltpu.VMEM((2, R, 128), jnp.float32),
@@ -804,8 +799,7 @@ def pallas_density(
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m), cfg.group)
     jf = jdata or (x, y, z, m)
     jp = pack_j_fields(jf, cfg.dma_cap)
-    rho, nc = engine(ranges, i_fields, jp, i_offset,
-                     aabb=_op_aabb(jf, box, cfg))
+    rho, nc = engine(ranges, i_fields, jp, i_offset)
     return rho.reshape(-1)[:n], nc.reshape(-1)[:n], ranges.occupancy
 
 
@@ -871,7 +865,7 @@ def pallas_iad(
         )
 
     # NOTE: an MXU variant (second moments around the group center via one
-    # (G,128)x(128,16) dot_general per chunk, using the engine's acc_widths
+    # (G,128)x(128,16) dot_general per chunk, engine commit 42af8de)
     # hook) measured SLOWER than the lane path on v5e (484 vs 434 ms/step,
     # Sedov 100^3): the per-chunk NT-dot relayout exceeds the ~20 VPU ops
     # it saves. Revisit if Mosaic grows a cheap lane-contraction.
@@ -882,8 +876,7 @@ def pallas_iad(
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h),), cfg.group)
     jf = jdata or (x, y, z, vol)
     jp = pack_j_fields(jf, cfg.dma_cap)
-    *cs, _nc = engine(ranges, i_fields, jp, i_offset,
-                      aabb=_op_aabb(jf, box, cfg))
+    *cs, _nc = engine(ranges, i_fields, jp, i_offset)
     return tuple(c.reshape(-1)[:n] for c in cs), ranges.occupancy
 
 
@@ -1082,8 +1075,7 @@ def pallas_ve_def_gradh(
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m, xm), cfg.group)
     jf = (x, y, z, m, xm)
     jp = pack_j_fields(jf, cfg.dma_cap)
-    kx, gradh, _nc = engine(ranges, i_fields, jp,
-                            aabb=_op_aabb(jf, box, cfg))  # single-chip (no jdata yet)
+    kx, gradh, _nc = engine(ranges, i_fields, jp)  # single-chip (no jdata yet)
     f = lambda a: a.reshape(-1)[:n]
     return (f(kx), f(gradh)), ranges.occupancy
 
