@@ -220,7 +220,7 @@ def _pallas_p2p(x, y, z, m, h, shift, allow_self, cfg: GravityConfig,
     engine = pp.group_pair_engine(
         pair_body, finalize, num_i=4, num_j=5, num_acc=4, cfg=nbr,
         fold=False, interpret=pp.pallas_interpret(),
-        num_slots=cfg.p2p_cap, pair_cutoff=False, want_nc=False,
+        pair_cutoff=False, want_nc=False,
     )
     # i-side blocks padded to the classification's chunked block count
     # (tail groups re-evaluate the last particle; trimmed by the caller)

@@ -192,15 +192,47 @@ def serve_windows(fields: Sequence, bounds_all, S: int, Wmax: int,
     return [annex[:, f] for f in range(nf)]
 
 
+def shard_halo_stage(x, y, z, h, keys, box, nbr, P: int, Wmax: int,
+                     axis: str):
+    """Shared prologue of a sharded pair-op stage: global table ->
+    group windows on the local slab -> localized runs + serve/jbuf
+    closures. One implementation for every sharded force stage so the
+    overflow contract cannot diverge between pipelines."""
+    from sphexa_tpu.sph.pallas_pairs import group_cell_ranges
+
+    S = x.shape[0]
+    k = jax.lax.axis_index(axis)
+    table = global_cell_table(keys, nbr.level, axis)
+    granges = group_cell_ranges(x, y, z, h, None, box, nbr, table=table)
+    ranges, bounds, escaped = localize_ranges(granges, S, P, Wmax, k, axis)
+
+    def serve(fields):
+        return serve_windows(fields, bounds, S, Wmax, P, k, axis)
+
+    def jbuf(own, halo):
+        return tuple(jnp.concatenate([o, a]) for o, a in zip(own, halo))
+
+    return ranges, serve, jbuf, escaped
+
+
+def fold_escape_sentinel(occ, escaped, cap: int, axis: str):
+    """Escaped runs mean truncated candidates: encode as an occupancy
+    overflow against the CALLER's cap so the driver re-sizes the halo
+    window (the shared overflow contract of every sharded stage)."""
+    occ = jnp.where(escaped, jnp.int32(cap + 1), occ)
+    return jax.lax.pmax(occ, axis)
+
+
 def localize_ranges(
     ranges: GroupRanges, S: int, P: int, Wmax: int, k, axis: str,
-) -> Tuple[GroupRanges, jax.Array]:
+) -> Tuple[GroupRanges, jax.Array, jax.Array]:
     """Rewrite a GLOBAL-row GroupRanges into j-buffer rows
-    [own slab (S) | annex (P * Wmax)] and produce the bounds matrix.
+    [own slab (S) | annex (P * Wmax)]. Returns (localized ranges,
+    all_gathered (P, P, 2) bounds matrix, escaped flag).
 
     Runs outside their source's served window (drift since the last
-    Wmax sizing) zero out and flip the returned ``escaped`` flag, which
-    the caller folds into the occupancy sentinel.
+    Wmax sizing) zero out and flip ``escaped``, which the caller folds
+    into the occupancy sentinel.
     """
     starts, lens, sh3, nruns, split_ovf = _split_runs(
         ranges.starts, ranges.lens,

@@ -67,11 +67,18 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
     """
     # GSPMD has no auto-partitioning rule for Mosaic (pallas) custom calls,
     # so the pallas pair stage runs under an explicit shard_map: each
-    # device executes the fused engine on its SFC slab
-    # (propagator._std_forces_sharded). The VE engine has no shard wrapper
-    # yet — those steps fall back to the GSPMD-partitioned XLA path.
+    # device executes the fused engine on its SFC slab with windowed
+    # all_to_all halos (propagator._std_forces_sharded /
+    # _ve_forces_sharded). The nbody step has no pair stage — it falls
+    # back to the GSPMD-partitioned XLA gravity path.
     if cfg.backend == "pallas":
-        if step_fn is step_hydro_std:
+        from sphexa_tpu.propagator import step_hydro_ve
+
+        # turb-ve / std-cooling share these force stages but carry extra
+        # per-step state (turbulence phases, chemistry) that this stepper
+        # signature does not thread through yet — they stay on the GSPMD
+        # XLA path, as does the pair-stage-free nbody step
+        if step_fn in (step_hydro_std, step_hydro_ve):
             cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p",
                                       halo_window=halo_window)
         else:

@@ -214,19 +214,8 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
         nbr = dataclasses.replace(nbr, run_cap=S_shard)
 
     def forces(box, keys, x, y, z, h, m, vx, vy, vz, temp):
-        S = x.shape[0]
-        k = jax.lax.axis_index(axis)
-        table = ex.global_cell_table(keys, nbr.level, axis)
-        granges = pp.group_cell_ranges(x, y, z, h, None, box, nbr,
-                                       table=table)
-        ranges, bounds, escaped = ex.localize_ranges(
-            granges, S, P, Wmax, k, axis
-        )
-        serve = lambda fields: ex.serve_windows(
-            fields, bounds, S, Wmax, P, k, axis
-        )
-        jbuf = lambda own, halo: tuple(
-            jnp.concatenate([o, a]) for o, a in zip(own, halo)
+        ranges, serve, jbuf, escaped = ex.shard_halo_stage(
+            x, y, z, h, keys, box, nbr, P, Wmax, axis
         )
 
         halo1 = serve((x, y, z, m))
@@ -252,11 +241,7 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
                         halo3[6], *halo3[7:])),
             interpret=interpret,
         )
-        # an escaped run means truncated candidates: fold into the
-        # occupancy sentinel (against the CALLER's cap — the local nbr may
-        # carry a clamped run_cap) so the driver re-sizes the halo window
-        occ = jnp.where(escaped, jnp.int32(cfg.nbr.cap + 1), occ)
-        occ = jax.lax.pmax(occ, axis)
+        occ = ex.fold_escape_sentinel(occ, escaped, cfg.nbr.cap, axis)
         dt_c = jax.lax.pmin(dt_c, axis)
         return rho, c, nc, occ, ax, ay, az, du, dt_c
 
@@ -272,6 +257,103 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
         check_vma=False,
     )(box, keys, state.x, state.y, state.z, state.h, state.m,
       state.vx, state.vy, state.vz, state.temp)
+    return out
+
+
+def _ve_forces_sharded(state, box, cfg: PropagatorConfig, keys):
+    """VE pair-op stage under shard_map — the flagship propagator on the
+    multi-chip fast path (HydroVeProp::computeForces, ve_hydro.hpp:131-208).
+
+    Same structure as _std_forces_sharded: shared prologue on the local
+    slab against the psum-built global cell table, candidate halos via
+    the windowed all_to_all exchange, one serve round per reference halo
+    epoch (xm; kx/prho/c/v; divv; alpha/gradv — ve_hydro.hpp:154-188).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+    from sphexa_tpu.parallel import exchange as ex
+    from sphexa_tpu.sph import pallas_pairs as pp
+
+    axis = cfg.shard_axis
+    const = cfg.const
+    nbr = cfg.nbr
+    interpret = _pallas_interpret()
+    P = cfg.mesh.shape[cfg.shard_axis]
+    S_shard = state.x.shape[0] // P
+    Wmax = min(cfg.halo_window, S_shard) or S_shard
+    if nbr.run_cap > S_shard:
+        nbr = dataclasses.replace(nbr, run_cap=S_shard)
+
+    def forces(box, min_dt, keys, x, y, z, h, m, vx, vy, vz, temp, alpha0):
+        ranges, serve, jbuf, escaped = ex.shard_halo_stage(
+            x, y, z, h, keys, box, nbr, P, Wmax, axis
+        )
+
+        hx, hy, hz, hh, hm = serve((x, y, z, h, m))
+        xm, nc, occ = pp.pallas_xmass(
+            x, y, z, h, m, None, box, const, nbr, ranges=ranges,
+            jdata=jbuf((x, y, z, m), (hx, hy, hz, hm)), interpret=interpret,
+        )
+        (hxm,) = serve((xm,))
+        (kx, gradh), _ = pp.pallas_ve_def_gradh(
+            x, y, z, h, m, xm, None, box, const, nbr, ranges=ranges,
+            jdata=jbuf((x, y, z, m, xm), (hx, hy, hz, hm, hxm)),
+            interpret=interpret,
+        )
+        prho, c, rho, p = hydro_ve.compute_eos_ve(temp, m, kx, xm, gradh, const)
+        hkx, hprho, hc, hvx, hvy, hvz = serve((kx, prho, c, vx, vy, vz))
+        cs, _ = pp.pallas_iad(
+            x, y, z, h, xm / kx, None, box, const, nbr, ranges=ranges,
+            jdata=jbuf((x, y, z, xm / kx), (hx, hy, hz, hxm / hkx)),
+            interpret=interpret,
+        )
+        c11, c12, c13, c22, c23, c33 = cs
+        dvout, _ = pp.pallas_iad_divv_curlv(
+            x, y, z, vx, vy, vz, h, kx, xm, *cs,
+            None, box, const, nbr, ranges=ranges,
+            with_gradv=cfg.av_clean,
+            jdata=jbuf((x, y, z, xm, vx, vy, vz),
+                       (hx, hy, hz, hxm, hvx, hvy, hvz)),
+            interpret=interpret,
+        )
+        divv, curlv, gradv = _split_dvout(dvout, cfg.av_clean)
+        dt_rho = rho_timestep(divv, const)
+        (hdivv,) = serve((divv,))
+        alpha = pp.pallas_av_switches(
+            x, y, z, vx, vy, vz, h, c, kx, xm, divv, alpha0, *cs,
+            None, box, min_dt, const, nbr, ranges=ranges,
+            jdata=jbuf((x, y, z, c, vx, vy, vz, xm / kx, divv),
+                       (hx, hy, hz, hc, hvx, hvy, hvz, hxm / hkx, hdivv)),
+            interpret=interpret,
+        )[0]
+        halo5 = serve((alpha, *cs) + tuple(gradv or ()))
+        halpha, *hcs_gv = halo5
+        hcs, hgv = hcs_gv[:6], hcs_gv[6:]
+        ax, ay, az, du, dt_c, _ = pp.pallas_momentum_energy_ve(
+            x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha, *cs,
+            None, box, const, nbr, nc=nc, gradv=gradv, ranges=ranges,
+            jdata=jbuf(
+                (x, y, z, h, vx, vy, vz, c, alpha, m, xm, kx, prho, *cs)
+                + tuple(gradv or ()),
+                (hx, hy, hz, hh, hvx, hvy, hvz, hc, halpha, hm, hxm, hkx,
+                 hprho, *hcs) + tuple(hgv),
+            ),
+            interpret=interpret,
+        )
+        occ = ex.fold_escape_sentinel(occ, escaped, cfg.nbr.cap, axis)
+        dt_c = jax.lax.pmin(dt_c, axis)
+        dt_rho = jax.lax.pmin(dt_rho, axis)
+        return rho, c, nc, occ, ax, ay, az, du, dt_c, dt_rho, alpha
+
+    Pp, Pr = PartitionSpec(axis), PartitionSpec()
+    out = shard_map(
+        forces,
+        mesh=cfg.mesh,
+        in_specs=(Pr, Pr, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp),
+        out_specs=(Pp, Pp, Pp, Pr, Pp, Pp, Pp, Pp, Pr, Pr, Pp),
+        check_vma=False,
+    )(box, state.min_dt, keys, state.x, state.y, state.z, state.h, state.m,
+      state.vx, state.vy, state.vz, state.temp, state.alpha)
     return out
 
 
@@ -432,7 +514,11 @@ def _ve_forces(
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
     vx, vy, vz = state.vx, state.vy, state.vz
 
-    if cfg.backend == "pallas":
+    if cfg.backend == "pallas" and cfg.shard_axis is not None:
+        # multi-chip fast path: per-shard Mosaic kernels + windowed halos
+        (rho, c, nc, occ, ax, ay, az, du, dt_courant, dt_rho,
+         alpha) = _ve_forces_sharded(state, box, cfg, keys)
+    elif cfg.backend == "pallas":
         # fused search+op TPU engine for the full VE sequence — the
         # reference's flagship propagator (ve_hydro.hpp:131-208) on the
         # fast path, sharing one cell-range prologue across all six ops

@@ -426,7 +426,6 @@ def group_pair_engine(
     cfg: NeighborConfig,
     fold: bool = False,
     interpret: bool = False,
-    num_slots: int = 0,
     pair_cutoff: bool = True,
     chunk_skip: Optional[bool] = None,
     want_nc: bool = True,
@@ -444,8 +443,6 @@ def group_pair_engine(
       outs is a tuple of (G,) arrays (f32), one per output.
     - ``num_i``/``num_j``: how many target/candidate fields the op reads
       (x, y, z are always fields 0-2 on both sides; h is i-field 3).
-    - ``num_slots``: unused (kept for call-site compatibility) — the
-      run-slot width is taken from the ranges arrays at call time.
     - ``pair_cutoff``: include the d2 < (2 h_i)^2 support test in the
       pair mask (SPH); gravity's near field keeps every ranged pair.
     - ``chunk_skip``: cull whole 128-candidate chunks whose bbox misses
@@ -1026,10 +1023,14 @@ def pallas_xmass(
 
 def pallas_ve_def_gradh(
     x, y, z, h, m, xm, sorted_keys, box: Box, const, cfg: NeighborConfig,
-    ranges=None, interpret: bool = False,
+    ranges=None, interpret: bool = False, jdata=None, i_offset=0,
 ):
     """VE normalization kx + grad-h correction (ve_def_gradh_kern.hpp:43-90)
-    with the search fused in. Returns ((kx, gradh), occupancy)."""
+    with the search fused in. Returns ((kx, gradh), occupancy).
+
+    Under shard_map, ``jdata = (x, y, z, m, xm)`` supplies the j-side
+    candidate arrays (slab + halo annex) the ranges index into — same
+    contract as pallas_density."""
     n = x.shape[0]
     wc = sinc_poly_coeffs(float(const.sinc_index))
     sinc_n = float(const.sinc_index)
@@ -1073,9 +1074,9 @@ def pallas_ve_def_gradh(
         want_nc=False,
     )
     i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m, xm), cfg.group)
-    jf = (x, y, z, m, xm)
+    jf = jdata or (x, y, z, m, xm)
     jp = pack_j_fields(jf, cfg.dma_cap)
-    kx, gradh, _nc = engine(ranges, i_fields, jp)  # single-chip (no jdata yet)
+    kx, gradh, _nc = engine(ranges, i_fields, jp, i_offset)
     f = lambda a: a.reshape(-1)[:n]
     return (f(kx), f(gradh)), ranges.occupancy
 
@@ -1085,11 +1086,15 @@ def pallas_iad_divv_curlv(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, with_gradv: bool = False, interpret: bool = False,
+    jdata=None, i_offset=0,
 ):
     """Velocity divergence/curl through the IAD gradient
     (divv_curlv_kern.hpp:43-120), optionally the full symmetrized
     velocity-gradient tensor for avClean. Returns (outs, occupancy) with
-    outs = (divv, curlv[, dv11..dv33])."""
+    outs = (divv, curlv[, dv11..dv33]).
+
+    Under shard_map, ``jdata = (x, y, z, xm, vx, vy, vz)`` supplies the
+    j-side candidate arrays — same contract as pallas_density."""
     n = x.shape[0]
     wc = sinc_poly_coeffs(float(const.sinc_index))
     K = float(const.K)
@@ -1166,9 +1171,10 @@ def pallas_iad_divv_curlv(
         (1.0 / (h * h), c11, c12, c13, c22, c23, c33, knorm, vx, vy, vz),
         cfg.group,
     )
-    jf = (x, y, z, xm, vx, vy, vz)
+    jf = jdata or (x, y, z, xm, vx, vy, vz)
     jp = pack_j_fields(jf, cfg.dma_cap)
-    *outs, _nc = engine(ranges, i_fields, jp, aabb=_op_aabb(jf, box, cfg))
+    *outs, _nc = engine(ranges, i_fields, jp, i_offset,
+                        aabb=_op_aabb(jf, box, cfg))
     f = lambda a: a.reshape(-1)[:n]
     return tuple(f(a) for a in outs), ranges.occupancy
 
@@ -1177,10 +1183,14 @@ def pallas_av_switches(
     x, y, z, vx, vy, vz, h, c, kx, xm, divv, alpha,
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, dt, const, cfg: NeighborConfig,
-    ranges=None, interpret: bool = False,
+    ranges=None, interpret: bool = False, jdata=None, i_offset=0,
 ):
     """Per-particle viscosity switch evolution (av_switches_kern.hpp:43-137)
-    with the search fused in. Returns (alpha_new (n,), occupancy)."""
+    with the search fused in. Returns (alpha_new (n,), occupancy).
+
+    Under shard_map, ``jdata = (x, y, z, c, vx, vy, vz, xm/kx, divv)``
+    supplies the j-side candidate arrays — same contract as
+    pallas_density."""
     n = x.shape[0]
     wc = sinc_poly_coeffs(float(const.sinc_index))
     K = float(const.K)
@@ -1254,9 +1264,10 @@ def pallas_av_switches(
          c11, c12, c13, c22, c23, c33, vx, vy, vz, alpha, dt_b),
         cfg.group,
     )
-    jf = (x, y, z, c, vx, vy, vz, xm / kx, divv)
+    jf = jdata or (x, y, z, c, vx, vy, vz, xm / kx, divv)
     jp = pack_j_fields(jf, cfg.dma_cap)
-    alpha_new, _nc = engine(ranges, i_fields, jp, aabb=_op_aabb(jf, box, cfg))
+    alpha_new, _nc = engine(ranges, i_fields, jp, i_offset,
+                            aabb=_op_aabb(jf, box, cfg))
     return alpha_new.reshape(-1)[:n], ranges.occupancy
 
 
@@ -1265,6 +1276,7 @@ def pallas_momentum_energy_ve(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, const, cfg: NeighborConfig, nc=None,
     gradv=None, ranges=None, interpret: bool = False,
+    jdata=None, i_offset=0,
 ):
     """VE momentum + energy (momentum_energy_kern.hpp:65-222) with the
     search fused in: Atwood-ramped crossed/uncrossed volume elements,
@@ -1274,7 +1286,11 @@ def pallas_momentum_energy_ve(
     The Atwood ramp's per-pair powers xm^(2-sigma) xm_j^sigma are
     evaluated as xm_i^2 exp(sigma (ln xm_j - ln xm_i)) with the logs
     precomputed per particle — one exp per pair side instead of pow().
-    """
+
+    Under shard_map, ``jdata = (x, y, z, h, vx, vy, vz, c, alpha, m, xm,
+    kx, prho, c11..c33[, gv11..gv33])`` supplies the RAW j-side candidate
+    arrays (derived per-j ratios are computed here); the trailing gradv
+    fields are present iff avClean. Same contract as pallas_density."""
     n = x.shape[0]
     wc = sinc_poly_coeffs(float(const.sinc_index))
     K = float(const.K)
@@ -1411,18 +1427,30 @@ def pallas_momentum_energy_ve(
     lx = jnp.log(xm)
     extra_i = [inv_h2, inv_h3, vx, vy, vz, c, alpha, xm, xm * xm, lx,
                rho, inv_rho, prho, c11, c12, c13, c22, c23, c33]
-    jfields = [x, y, z, inv_h2, inv_h3, vx, vy, vz, c, alpha, m, xm,
-               xm * xm, lx, rho, inv_rho, prho,
-               c11, c12, c13, c22, c23, c33]
     if av_clean:
         eta_crit = jnp.cbrt(
             32.0 * np.pi / 3.0 / (nc.astype(jnp.float32) + 1.0)
         )
         extra_i = extra_i + [eta_crit] + list(gradv)
-        jfields = jfields + list(gradv)
+    if jdata is None:
+        jfields = [x, y, z, inv_h2, inv_h3, vx, vy, vz, c, alpha, m, xm,
+                   xm * xm, lx, rho, inv_rho, prho,
+                   c11, c12, c13, c22, c23, c33]
+        if av_clean:
+            jfields = jfields + list(gradv)
+    else:
+        (xj, yj, zj, hj, vxj, vyj, vzj, cj, alj, mj, xmj, kxj, prhoj,
+         j11, j12, j13, j22, j23, j33, *gvj) = jdata
+        inv_h2j = 1.0 / (hj * hj)
+        rhoj = kxj * mj / xmj
+        jfields = [xj, yj, zj, inv_h2j, inv_h2j / hj, vxj, vyj, vzj, cj,
+                   alj, mj, xmj, xmj * xmj, jnp.log(xmj), rhoj, 1.0 / rhoj,
+                   prhoj, j11, j12, j13, j22, j23, j33]
+        if av_clean:
+            jfields = jfields + list(gvj)
     i_fields = _prep_i(x, y, z, h, tuple(extra_i), cfg.group)
     jp = pack_j_fields(tuple(jfields), cfg.dma_cap)
-    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp,
+    ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp, i_offset,
                                        aabb=_op_aabb(jfields, box, cfg))
     f = lambda a: a.reshape(-1)[:n]
     return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy

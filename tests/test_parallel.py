@@ -230,3 +230,87 @@ class TestHaloExchange:
                 nbr, P=8, margin=1.0, quantum=1,
             ))
         assert widths[1] <= widths[0]
+
+
+class TestShardedVE:
+    """The flagship VE pipeline on the multi-chip fast path (VERDICT r2 #3):
+    per-shard Mosaic kernels with windowed halos for the whole
+    xmass->gradh->IAD->divv->AV->momentum sequence."""
+
+    def test_sharded_ve_pallas_matches_single(self):
+        import numpy as np
+
+        from sphexa_tpu.propagator import step_hydro_ve
+
+        state, box, const = init_sedov(16)
+        cfg = make_propagator_config(state, box, const, block=512,
+                                     backend="pallas")
+        ref_state, _, ref_diag = step_hydro_ve(state, box, cfg)
+
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg, step_fn=step_hydro_ve)
+        out_state, _, out_diag = step(sstate, box)
+        assert out_state.x.sharding.spec == jax.sharding.PartitionSpec("p")
+        np.testing.assert_allclose(
+            np.asarray(out_state.x), np.asarray(ref_state.x),
+            rtol=1e-5, atol=1e-7,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_state.alpha), np.asarray(ref_state.alpha),
+            rtol=1e-4, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            float(out_diag["dt"]), float(ref_diag["dt"]), rtol=1e-5
+        )
+
+    def test_sharded_ve_avclean_matches_single(self):
+        import numpy as np
+
+        from sphexa_tpu.propagator import step_hydro_ve
+
+        state, box, const = init_sedov(16)
+        cfg = make_propagator_config(state, box, const, block=512,
+                                     backend="pallas", av_clean=True)
+        ref_state, _, _ = step_hydro_ve(state, box, cfg)
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg, step_fn=step_hydro_ve)
+        out_state, _, _ = step(sstate, box)
+        np.testing.assert_allclose(
+            np.asarray(out_state.vx), np.asarray(ref_state.vx),
+            rtol=1e-4, atol=1e-6,
+        )
+
+
+class TestShardedNbody:
+    """Gravity-only N-body under the sharded step (the sharded-nbody
+    coverage flagged in VERDICT r2 'What's weak' #9)."""
+
+    def test_sharded_nbody_matches_single(self):
+        import numpy as np
+
+        from sphexa_tpu.init import init_evrard
+        from sphexa_tpu.propagator import step_nbody
+        from sphexa_tpu.simulation import Simulation
+
+        state, box, const = init_evrard(16, overrides={"G": 1.0})
+        n8 = (state.n // 8) * 8
+        state = jax.tree.map(
+            lambda a: a[:n8] if getattr(a, "ndim", 0) == 1 else a, state
+        )
+        sim = Simulation(state, box, const, prop="nbody", block=512)
+        ref_state, _, ref_diag = sim._launch()[:3]
+
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, sim._cfg, step_fn=step_nbody)
+        out_state, _, out_diag = step(sstate, box, sim._gtree)
+        assert out_state.x.sharding.spec == jax.sharding.PartitionSpec("p")
+        np.testing.assert_allclose(
+            np.asarray(out_state.vx), np.asarray(ref_state.vx),
+            rtol=5e-4, atol=5e-7,
+        )
+        np.testing.assert_allclose(
+            float(out_diag["egrav"]), float(ref_diag["egrav"]), rtol=1e-5
+        )
