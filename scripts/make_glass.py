@@ -1,0 +1,28 @@
+"""Generate a relaxed glass template block and save it as HDF5.
+
+The output file feeds the CLI's --glass flag (and the reference's
+readTemplateBlock format). Usage:
+
+    python scripts/make_glass.py [side=16] [relax_steps=40] [out=glass.h5]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    side = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 40
+    out = sys.argv[3] if len(sys.argv) > 3 else "glass.h5"
+
+    from sphexa_tpu.init.glass import generate_glass_template, write_template_block
+
+    x, y, z = generate_glass_template(side, steps)
+    write_template_block(out, x, y, z)
+    print(f"wrote {len(x)} glass particles to {out}")
+
+
+if __name__ == "__main__":
+    main()

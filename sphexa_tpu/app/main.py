@@ -51,6 +51,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "final snapshot before exiting if -w is enabled")
     p.add_argument("--profile", action="store_true",
                    help="save a per-iteration timing series to profile.npz")
+    p.add_argument("--insitu", default=None,
+                   help="in-situ rendering per iteration: slice | projection "
+                        "(the Ascent/Catalyst adaptor role, ascent_adaptor.h)")
+    p.add_argument("--insitu-every", type=int, default=1, dest="insitu_every",
+                   help="render every N iterations (default 1)")
+    p.add_argument("--kernel", default=None,
+                   help="SPH kernel family: sinc | sinc-n1-n2 | wendland-c6 "
+                        "(sph_kernel_tables.hpp SphKernelType)")
+    p.add_argument("--sincIndex", type=float, default=None, dest="sinc_index",
+                   help="sinc kernel exponent n (default: case setting)")
     return p
 
 
@@ -157,6 +167,21 @@ def main(argv=None) -> int:
         import dataclasses as _dc
 
         const = _dc.replace(const, g=args.grav_constant)
+    if args.kernel is not None or args.sinc_index is not None:
+        import dataclasses as _dc
+
+        from sphexa_tpu.sph.kernels import KERNEL_CHOICES, kernel_norm_3d
+
+        kind = args.kernel or const.kernel_choice
+        if kind not in KERNEL_CHOICES:
+            print(f"unknown --kernel {kind!r}; choices: {KERNEL_CHOICES}",
+                  file=sys.stderr)
+            return 2
+        n = args.sinc_index if args.sinc_index is not None else const.sinc_index
+        const = _dc.replace(
+            const, kernel_choice=kind, sinc_index=n,
+            kernel_norm=kernel_norm_3d(n, kind),
+        )
 
     # observable selected by the test case (observables/factory.hpp:46-70) —
     # on restart, by the case name the snapshot recorded; field-consuming
@@ -313,6 +338,20 @@ def main(argv=None) -> int:
     from sphexa_tpu.util.timer import ProfileRecorder, Timer
 
     timer = Timer()
+    # in-situ viz adaptor: init before the loop, execute per iteration,
+    # finalize after (sphexa.cpp:141-142,172,179 hook points)
+    insitu = None
+    if args.insitu:
+        from sphexa_tpu.viz import InsituViz
+
+        try:
+            insitu = InsituViz(args.out_dir, mode=args.insitu,
+                               every=args.insitu_every)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        insitu.init()
+
     profile = ProfileRecorder()
     t0 = time.time()
     it0 = sim.iteration
@@ -326,6 +365,8 @@ def main(argv=None) -> int:
         row = constants.write(it, sim.state, sim.box, e, fields)
         timer.step("observables")
         maybe_dump(it)  # dumps recompute the full derived set (r, p, u, ...)
+        if insitu is not None:
+            insitu.execute(sim.state, sim.box, it)
         timer.step("output")
         if args.profile:
             profile.record(it, timer.pop(), dt=float(d["dt"]),
@@ -360,6 +401,8 @@ def main(argv=None) -> int:
             + " ".join(f"{k}={v:.4f}" for k, v in means.items()
                        if k in ("step", "observables", "output")))
         log(f"# timing series -> {profile_path}")
+    if insitu is not None:
+        log(f"# insitu: {insitu.finalize()} frames -> {args.out_dir}")
     log(f"# {n_done} iterations in {dt_wall:.2f}s "
         f"({state.n * n_done / dt_wall / 1e6:.3f}M particle-updates/s)")
     return 0

@@ -210,6 +210,11 @@ def compute_gravity_ewald(
     n = x.shape[0]
     r = ecfg.num_replica_shells
 
+    if cfg.multipole_order > 0:
+        raise NotImplementedError(
+            "spherical multipoles are open-boundary only; the Ewald path "
+            "keeps the cartesian quadrupole (traversal_ewald_cpu.hpp parity)"
+        )
     mp_cache = compute_multipoles(x, y, z, m, sorted_keys, tree, meta)
     node_mass, node_com, node_q, _ = mp_cache
 

@@ -16,11 +16,25 @@ import jax.numpy as jnp
 from jax.ops import segment_sum
 
 
-def p2m_leaf(x, y, z, m, pleaf, leaf_com, num_leaves):
+def edge_segment_sum(w, edges):
+    """Segment sums of ROW-CONTIGUOUS segments: cumulative sum differenced
+    at the segment edges. Particles arrive SFC-sorted, so a leaf's rows
+    are contiguous — this replaces scatter-add segment_sum, which
+    serializes on TPU (~10 ms per 65k-row scatter). The f32 prefix sum
+    costs ~n*eps relative error on small segments (<= ~2e-4 at 1e6 rows),
+    well under the theta-truncation error of the multipole expansion."""
+    c = jnp.cumsum(w, axis=0)
+    c = jnp.concatenate([jnp.zeros_like(c[:1]), c], axis=0)
+    return c[edges[1:]] - c[edges[:-1]]
+
+
+def p2m_leaf(x, y, z, m, pleaf, leaf_com, num_leaves, edges=None):
     """Trace-free quadrupole of every leaf around its center of mass.
 
     Vectorized counterpart of P2M (cartesian_qpole.hpp:89): raw second
     moments via one segment-sum per component, then the trace removal.
+    ``edges`` (L+1,) row boundaries select the fast contiguous-segment
+    path (edge_segment_sum); without them a scatter segment_sum runs.
     """
     dx = x - leaf_com[pleaf, 0]
     dy = y - leaf_com[pleaf, 1]
@@ -30,7 +44,10 @@ def p2m_leaf(x, y, z, m, pleaf, leaf_com, num_leaves):
          m * dy * dy, m * dy * dz, m * dz * dz],
         axis=1,
     )
-    q = segment_sum(raw, pleaf, num_segments=num_leaves)  # (L, 6)
+    if edges is not None:
+        q = edge_segment_sum(raw, edges)  # (L, 6)
+    else:
+        q = segment_sum(raw, pleaf, num_segments=num_leaves)  # (L, 6)
     return _remove_trace(q)
 
 

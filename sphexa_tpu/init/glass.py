@@ -109,6 +109,69 @@ def compress_center_cube(x, y, z, r_int: float, s: float, r_ext: float, eps=0.0)
 _ACTIVE_TEMPLATE = None
 
 
+def generate_glass_template(
+    side: int = 16, relax_steps: int = 40, seed: int = 7,
+):
+    """Generate a relaxed glass block in [0,1)^3 (the generate-once half
+    of the reference's template pipeline; the reference ships pre-relaxed
+    HDF5 blocks, main/src/init/utils.hpp:100-168 only reads them).
+
+    Classic damped relaxation: evolve a jittered periodic lattice with
+    the std SPH pipeline at uniform internal energy and ZERO the
+    velocities after every step — pressure gradients from density
+    fluctuations push particles apart until the distribution is glassy
+    (uniform density, no lattice axes). Returns (x, y, z) in [0, 1)^3.
+    """
+    import dataclasses as _dc
+
+    import jax
+
+    from sphexa_tpu.simulation import Simulation
+    from sphexa_tpu.init import init_sedov
+
+    # reuse the sedov periodic-box scaffolding at uniform energy: a
+    # uniform-pressure periodic gas whose only dynamics is relaxation
+    state, box, const = init_sedov(side)
+    state = _dc.replace(
+        state,
+        temp=jax.numpy.ones_like(state.temp),
+        du=jax.numpy.zeros_like(state.du),
+        du_m1=jax.numpy.zeros_like(state.du_m1),
+    )
+    sim = Simulation(state, box, const, prop="std", block=2048)
+    z3 = lambda a: jax.numpy.zeros_like(a)
+    for _ in range(relax_steps):
+        sim.step()
+        # damp: kill velocities (and energy drift) every step
+        sim.state = _dc.replace(
+            sim.state,
+            vx=z3(sim.state.vx), vy=z3(sim.state.vy), vz=z3(sim.state.vz),
+            temp=jax.numpy.ones_like(sim.state.temp),
+            du=z3(sim.state.du), du_m1=z3(sim.state.du_m1),
+        )
+    x = np.asarray(sim.state.x)
+    y = np.asarray(sim.state.y)
+    z = np.asarray(sim.state.z)
+    lo = np.asarray(sim.box.lo, np.float64)
+    lengths = np.asarray(sim.box.lengths, np.float64)
+    return (
+        (x - lo[0]) / lengths[0] % 1.0,
+        (y - lo[1]) / lengths[1] % 1.0,
+        (z - lo[2]) / lengths[2] % 1.0,
+    )
+
+
+def write_template_block(path: str, x, y, z):
+    """Save a template block to HDF5 (readable by read_template_block
+    and by the reference's readTemplateBlock)."""
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        f.create_dataset("x", data=np.asarray(x, np.float64))
+        f.create_dataset("y", data=np.asarray(y, np.float64))
+        f.create_dataset("z", data=np.asarray(z, np.float64))
+
+
 def read_template_block(path: str):
     """Read the x/y/z template coordinates from an HDF5 file (either a
     dump with Step#n groups or flat root datasets) and normalize them to

@@ -18,15 +18,12 @@ from sphexa_tpu.sph.particles import ParticleState, SimConstants, ideal_gas_cv
 
 def turbulence_constants() -> Dict[str, float]:
     """Test-case settings (turbulence_init.hpp TurbulenceConstants)."""
-    # the reference's powerLawExp/anglesExp (stSpectForm=2 power-law
-    # sampling) are not implemented; create_stirring_modes rejects
-    # spect_form values other than 0/1, so they are omitted here rather
-    # than accepted-and-ignored
     return {
         "solWeight": 0.5, "stMaxModes": 100000, "Lbox": 1.0,
         "stEnergyPrefac": 5.0e-3, "stMachVelocity": 0.3,
         "minDt": 1e-4, "minDt_m1": 1e-4,
         "rngSeed": 251299, "stSpectForm": 1, "mTotal": 1.0,
+        "powerLawExp": 5.0 / 3.0, "anglesExp": 2.0,
         "gamma": 1.001, "mui": 0.62, "u0": 1000.0, "Kcour": 0.4,
         "gravConstant": 0.0, "ng0": 100, "ngmax": 150, "turbulence": 1.0,
     }

@@ -45,7 +45,7 @@ _CONST_ATTRS = {
     "alphamax": "alphamax", "decay_constant": "decay_constant",
     "at_min": "Atmin", "at_max": "Atmax", "g": "gravConstant",
     "eps": "eps", "eta_acc": "etaAcc", "max_dt_increase": "maxDtIncrease",
-    "sinc_index": "sincIndex",
+    "sinc_index": "sincIndex", "kernel_choice": "kernelChoice",
 }
 
 
@@ -66,7 +66,10 @@ def _step_attrs(state: ParticleState, box: Box, const: SimConstants,
         "box_boundaries": np.asarray([int(b) for b in box.boundaries], np.int64),
     }
     for field, name in _CONST_ATTRS.items():
-        attrs[name] = np.float64(getattr(const, field))
+        v = getattr(const, field)
+        attrs[name] = (
+            np.bytes_(v.encode()) if isinstance(v, str) else np.float64(v)
+        )
     return attrs
 
 
@@ -201,8 +204,13 @@ def read_snapshot_full(
     const_kw = {}
     for field, name in _CONST_ATTRS.items():
         if name in attrs:
-            cast = int if field in ("ng0", "ngmax") else float
-            const_kw[field] = cast(attrs[name])
+            if field == "kernel_choice":
+                v = attrs[name]
+                v = v.item() if hasattr(v, "item") else v
+                const_kw[field] = v.decode() if isinstance(v, bytes) else str(v)
+            else:
+                cast = int if field in ("ng0", "ngmax") else float
+                const_kw[field] = cast(attrs[name])
     const = SimConstants(**const_kw).normalized()
 
     box = Box(

@@ -199,6 +199,8 @@ class Simulation:
                 sol_weight=s["solWeight"],
                 spect_form=int(s["stSpectForm"]),
                 seed=int(s["rngSeed"]),
+                power_law_exp=float(s.get("powerLawExp", 5.0 / 3.0)),
+                angles_exp=float(s.get("anglesExp", 2.0)),
             )
             # a caller-provided state (checkpoint restore) overrides the
             # fresh OU phases but keeps the derived static config

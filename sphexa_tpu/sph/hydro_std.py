@@ -27,7 +27,7 @@ def compute_density(x, y, z, h, m, nidx, nmask, box: Box, const: SimConstants, b
 
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
-        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
+        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice)
         rho0 = m[idx] + msum(g.mask, m[g.nj] * w)
         h_i = h[idx]
         return const.K * rho0 / (h_i * h_i * h_i)
@@ -56,7 +56,7 @@ def compute_iad(x, y, z, h, vol_j, nidx, nmask, box: Box, const: SimConstants, b
 
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
-        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
+        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice)
         vw = jnp.where(g.mask, vol_j[g.nj] * w, 0.0)
         t11 = jnp.sum(g.rx * g.rx * vw, -1)
         t12 = jnp.sum(g.rx * g.ry * vw, -1)
@@ -105,9 +105,9 @@ def compute_momentum_energy_std(
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
         h_i = h[idx][:, None]
         h_j = h[g.nj]
-        w_i = sinc_kernel_u(g.v1 * g.v1, const.sinc_index) / (h_i * h_i * h_i)
+        w_i = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice) / (h_i * h_i * h_i)
         v2 = g.dist / h_j
-        w_j = sinc_kernel_u(v2 * v2, const.sinc_index) / (h_j * h_j * h_j)
+        w_j = sinc_kernel_u(v2 * v2, const.sinc_index, const.kernel_choice) / (h_j * h_j * h_j)
 
         vx_ij = vx[idx][:, None] - vx[g.nj]
         vy_ij = vy[idx][:, None] - vy[g.nj]

@@ -58,20 +58,20 @@ def create_stirring_modes(
     ndim: int = 3,
     seed: int = 251299,
     eps: float = 1e-15,
+    power_law_exp: float = 5.0 / 3.0,
+    angles_exp: float = 2.0,
 ) -> Tuple[TurbulenceConfig, TurbulenceState]:
     """Build the stirring mode table + initial OU state.
 
     Mirrors TurbulenceData's constructor pipeline: stirring band
-    k in [2pi/L, 3*2pi/L], band (spect_form=0) or parabolic (=1) spectrum,
+    k in [2pi/L, 3*2pi/L], band (spect_form=0), parabolic (=1) or
+    power-law random-angle (=2, create_modes.hpp:179-238) spectrum,
     mirrored +-ky/+-kz modes (create_modes.hpp:30-160), OU variance from
     the target Mach energy input rate.
     """
-    if spect_form not in (0, 1):
-        raise NotImplementedError(
-            "spect_form must be 0 (band) or 1 (parabolic); the reference's "
-            "power-law sampling (spectForm=2, create_modes.hpp:162+) is not "
-            "implemented"
-        )
+    if spect_form not in (0, 1, 2):
+        raise ValueError("spect_form must be 0 (band), 1 (parabolic) or "
+                         "2 (power law)")
     twopi = 2.0 * np.pi
     velocity = mach_velocity
     energy = energy_prefac * velocity**3 / lbox
@@ -84,12 +84,51 @@ def create_stirring_modes(
         / np.sqrt(1.0 - 2.0 * sol_weight + ndim * sol_weight**2)
     )
 
-    kc = stir_min if spect_form == 0 else 0.5 * (stir_min + stir_max)
+    kc = 0.5 * (stir_min + stir_max) if spect_form == 1 else stir_min
     parab_prefact = -4.0 / (stir_max - stir_min) ** 2
 
     ik_max = int(np.ceil(stir_max / twopi * lbox)) + 1
     modes, amplitudes = [], []
-    for ikx in range(0, ik_max + 1):
+    if spect_form == 2:
+        # power-law spectrum, random-angle shell sampling
+        # (create_modes.hpp:179-238): nang ~ 2^ndim ceil(ik^anglesExp)
+        # directions per k-shell, amplitude (k/kc)^powerLawExp with the
+        # angle-count correction
+        rng = np.random.default_rng(seed)
+        ik_min = max(1, int(stir_min * lbox / twopi + 0.5))
+        ik_hi = int(stir_max * lbox / twopi + 0.5)
+        for ik in range(ik_min, ik_hi + 1):
+            nang = int(2**ndim * np.ceil(ik**angles_exp))
+            for _ in range(nang):
+                phi = twopi * rng.uniform()
+                theta = (np.arccos(1.0 - 2.0 * rng.uniform())
+                         if ndim > 2 else 0.5 * np.pi)
+                rand = ik + rng.uniform() - 0.5
+                kx = twopi * np.round(rand * np.sin(theta) * np.cos(phi)) / lbox
+                ky = (twopi * np.round(rand * np.sin(theta) * np.sin(phi)) / lbox
+                      if ndim > 1 else 0.0)
+                kz = (twopi * np.round(rand * np.cos(theta)) / lbox
+                      if ndim > 2 else 0.0)
+                k = np.sqrt(kx**2 + ky**2 + kz**2)
+                if not (stir_min <= k <= stir_max):
+                    continue
+                # PARITY NOTE: the reference computes pow(k/kc, +powerLawExp)
+                # with default powerLawExp = 5/3 (create_modes.hpp:222,
+                # turbulence_init.hpp:61) — a spectrum RISING with k over
+                # the driving band; reproduced verbatim. A decaying
+                # Kolmogorov band needs powerLawExp = -5/3 in the settings.
+                amp = (k / kc) ** power_law_exp
+                amp = np.sqrt(
+                    amp * (ik ** (ndim - 1) * 4.0 * np.sqrt(3.0) / nang)
+                ) * (kc / k) ** (0.5 * (ndim - 1))
+                modes.append((kx, ky, kz))
+                amplitudes.append(amp)
+                if len(modes) > st_max_modes:
+                    raise ValueError(
+                        f"too many stirring modes ({len(modes)} > {st_max_modes})"
+                    )
+    else:
+      for ikx in range(0, ik_max + 1):
         kx = twopi * ikx / lbox
         for iky in range(0, ik_max + 1 if ndim > 1 else 1):
             ky = twopi * iky / lbox

@@ -32,7 +32,7 @@ def compute_xmass(x, y, z, h, m, nidx, nmask, box: Box, const: SimConstants, blo
 
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
-        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
+        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice)
         rho0 = m[idx] + msum(g.mask, m[g.nj] * w)
         h_i = h[idx]
         return m[idx] / (rho0 * const.K / (h_i * h_i * h_i))
@@ -52,8 +52,8 @@ def compute_ve_def_gradh(
 
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
-        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
-        dterh = sinc_dterh_u(g.v1 * g.v1, const.sinc_index)
+        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice)
+        dterh = sinc_dterh_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice)
 
         xm_i = xm[idx]
         m_i = m[idx]
@@ -105,7 +105,7 @@ def compute_iad_divv_curlv(
 
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
-        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
+        w = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice)
 
         tA1, tA2, tA3 = iad_project(
             c11[idx][:, None], c12[idx][:, None], c13[idx][:, None],
@@ -157,7 +157,7 @@ def compute_av_switches(
     def body(idx):
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
         h_i = h[idx]
-        w = const.K / (h_i * h_i * h_i)[:, None] * sinc_kernel_u(g.v1 * g.v1, const.sinc_index)
+        w = const.K / (h_i * h_i * h_i)[:, None] * sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice)
 
         vx_ij = vx[idx][:, None] - vx[g.nj]
         vy_ij = vy[idx][:, None] - vy[g.nj]
@@ -241,9 +241,9 @@ def compute_momentum_energy_ve(
         h_j = h[g.nj]
         hi3 = h_i * h_i * h_i
         hj3 = h_j * h_j * h_j
-        w_i = sinc_kernel_u(g.v1 * g.v1, const.sinc_index) / hi3
+        w_i = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice) / hi3
         v2 = g.dist / h_j
-        w_j = sinc_kernel_u(v2 * v2, const.sinc_index) / hj3
+        w_j = sinc_kernel_u(v2 * v2, const.sinc_index, const.kernel_choice) / hj3
 
         vx_ij = vx[idx][:, None] - vx[g.nj]
         vy_ij = vy[idx][:, None] - vy[g.nj]

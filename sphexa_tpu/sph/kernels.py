@@ -23,30 +23,60 @@ import jax.numpy as jnp
 
 SUPPORT = 2.0  # kernel support radius in units of h
 
+# Kernel families (the reference's SphKernelType enum,
+# sph_kernel_tables.hpp:122-160, plus one non-sinc family):
+#   "sinc"        — sinc(pi v / 2)^n (SPHYNX default, n = sinc_index)
+#   "sinc-n1-n2"  — 0.9 sinc^4 + 0.1 sinc^9 (SincN1SincN2, fixed mix)
+#   "wendland-c6" — Wendland C6 (Dehnen & Aly 2012), support 2h
+KERNEL_CHOICES = ("sinc", "sinc-n1-n2", "wendland-c6")
+
+
+def _kernel_samples(v: np.ndarray, n: float, kind: str) -> np.ndarray:
+    """W(v) on v in [0, 2] in float64 (fit/normalization reference)."""
+    def sincn(e):
+        pv = 0.5 * np.pi * v
+        s = np.ones_like(v)
+        nz = v > 0
+        s[nz] = np.sin(pv[nz]) / pv[nz]
+        return s ** float(e)
+
+    if kind == "sinc":
+        return sincn(n)
+    if kind == "sinc-n1-n2":
+        return 0.9 * sincn(4.0) + 0.1 * sincn(9.0)
+    if kind == "wendland-c6":
+        q = np.clip(v / 2.0, 0.0, 1.0)
+        return (1.0 - q) ** 8 * (1.0 + 8.0 * q + 25.0 * q**2 + 32.0 * q**3)
+    raise ValueError(f"unknown kernel kind {kind!r} (choices: {KERNEL_CHOICES})")
+
 
 @functools.lru_cache(maxsize=None)
-def sinc_poly_coeffs(n: float, degree: int = 13) -> tuple:
-    """Power coefficients of W_n as a polynomial in s = v^2/2 - 1.
+def kernel_poly_coeffs(n: float, kind: str = "sinc", degree: int = 0) -> tuple:
+    """Power coefficients of W as a polynomial in s = v^2/2 - 1.
 
-    W_n(v) = sinc(pi v/2)^n is an even entire function of v, hence
-    analytic in u = v^2; a Chebyshev fit on u in [0, 4] evaluated in the
-    centered variable s in [-1, 1] keeps every Horner intermediate O(1),
-    so the f32 evaluation stays at the ~3e-7 rounding floor (a plain fit
-    in u overflows to ~5e-5 through coefficient cancellation). Works for
-    any real exponent n — the reference's integer-n table restriction
-    (sph_kernel_tables.hpp:122-160) does not apply.
+    Sinc-family kernels are even entire functions of v, hence analytic in
+    u = v^2; a Chebyshev fit on u in [0, 4] evaluated in the centered
+    variable s in [-1, 1] keeps every Horner intermediate O(1), so the
+    f32 evaluation stays at the ~3e-7 rounding floor (a plain fit in u
+    overflows to ~5e-5 through coefficient cancellation). Works for any
+    real exponent n — the reference's integer-n table restriction
+    (sph_kernel_tables.hpp:122-160) does not apply. Wendland C6 has odd
+    powers of v (C^6 at the origin in u), so it gets a higher degree;
+    its fit error is ~2e-6 (pinned by tests/test_kernels).
     """
+    if degree == 0:
+        degree = 13 if kind.startswith("sinc") else 19
     t = np.cos(np.linspace(0.0, np.pi, 4000))  # [-1, 1] chebyshev nodes
     u = 2.0 * (t + 1.0)  # [0, 4]
-    v = np.sqrt(u)
-    pv = 0.5 * np.pi * v
-    sinc = np.ones_like(v)
-    nz = v > 0
-    sinc[nz] = np.sin(pv[nz]) / pv[nz]
-    w = sinc ** float(n)
+    w = _kernel_samples(np.sqrt(u), float(n), kind)
     cheb = np.polynomial.chebyshev.Chebyshev.fit(t, w, degree, domain=[-1, 1])
     coeffs = cheb.convert(kind=np.polynomial.Polynomial).coef
     return tuple(float(c) for c in coeffs)
+
+
+def sinc_poly_coeffs(n: float, degree: int = 13) -> tuple:
+    """Back-compat alias: the default sinc-family fit."""
+    return kernel_poly_coeffs(n, "sinc", degree)
 
 
 def sinc_poly_eval(u, coeffs):
@@ -62,14 +92,14 @@ def sinc_poly_eval(u, coeffs):
     return jnp.maximum(acc, 0.0)
 
 
-def sinc_kernel_u(u, n: float = 6.0):
-    """W_n from the SQUARED normalized distance (polynomial form of
-    ``sinc_kernel``, see sinc_poly_coeffs)."""
-    return sinc_poly_eval(u, sinc_poly_coeffs(float(n)))
+def sinc_kernel_u(u, n: float = 6.0, kind: str = "sinc"):
+    """W from the SQUARED normalized distance (polynomial form, see
+    kernel_poly_coeffs; the name keeps the historical sinc default)."""
+    return sinc_poly_eval(u, kernel_poly_coeffs(float(n), kind))
 
 
 @functools.lru_cache(maxsize=None)
-def sinc_dterh_coeffs(n: float, degree: int = 13) -> tuple:
+def kernel_dterh_coeffs(n: float, kind: str = "sinc", degree: int = 0) -> tuple:
     """Coefficients of dterh(v) = -(3 W + v dW/dv) in s = v^2/2 - 1.
 
     The h-derivative combination of ve_def_gradh_kern.hpp:58-66, derived
@@ -77,7 +107,7 @@ def sinc_dterh_coeffs(n: float, degree: int = 13) -> tuple:
     so dterh = -(3 p + 2(s+1) p') — exactly consistent with the W the
     pair ops evaluate (f32 error ~2e-6, and dterh(0) = -3 by
     construction)."""
-    c = sinc_poly_coeffs(n, degree)
+    c = kernel_poly_coeffs(n, kind, degree)
     d = []
     for k in range(len(c)):
         v = (3.0 + 2.0 * k) * c[k]
@@ -87,15 +117,20 @@ def sinc_dterh_coeffs(n: float, degree: int = 13) -> tuple:
     return tuple(d)
 
 
-def sinc_dterh_u(u, n: float = 6.0):
-    """dterh = -(3 W + v dW/dv) from the SQUARED normalized distance
-    (no zero-floor: dterh is negative inside the support)."""
-    coeffs = sinc_dterh_coeffs(float(n))
+def dterh_poly_eval(u, coeffs):
+    """Horner in s = u/2 - 1 WITHOUT the zero floor (dterh is negative
+    inside the support). SINGLE evaluator shared by the XLA ops and the
+    Pallas tile kernels (mirror of sinc_poly_eval)."""
     s = jnp.clip(u * 0.5 - 1.0, -1.0, 1.0)
     acc = jnp.full_like(s, coeffs[-1])
     for c in coeffs[-2::-1]:
         acc = acc * s + c
     return acc
+
+
+def sinc_dterh_u(u, n: float = 6.0, kind: str = "sinc"):
+    """dterh = -(3 W + v dW/dv) from the SQUARED normalized distance."""
+    return dterh_poly_eval(u, kernel_dterh_coeffs(float(n), kind))
 
 
 def sinc_kernel(v, n: float = 6.0):
@@ -123,7 +158,8 @@ def sinc_kernel_derivative(v, n: float = 6.0):
     return jnp.where(v > 0.0, n * sinc ** (n - 1.0) * dsinc, 0.0)
 
 
-def kernel_norm_3d(n: float = 6.0, support: float = SUPPORT, num: int = 20001) -> float:
+def kernel_norm_3d(n: float = 6.0, kind: str = "sinc",
+                   support: float = SUPPORT, num: int = 20001) -> float:
     """3D normalization K with ∫ K W(|x|/h) h^-3 d^3x = 1.
 
     Same quantity as the reference's kernel_3D_k (sph_kernel_tables.hpp:77-84),
@@ -132,10 +168,7 @@ def kernel_norm_3d(n: float = 6.0, support: float = SUPPORT, num: int = 20001) -
     if num % 2 == 0:
         num += 1  # composite Simpson needs an even interval count
     x = np.linspace(0.0, support, num)
-    pv = 0.5 * np.pi * x
-    sinc = np.ones_like(x)
-    sinc[1:] = np.sin(pv[1:]) / pv[1:]
-    f = 4.0 * np.pi * x**2 * sinc**n
+    f = 4.0 * np.pi * x**2 * _kernel_samples(x, n, kind)
     dx = x[1] - x[0]
     integral = dx / 3.0 * (f[0] + f[-1] + 4.0 * f[1:-1:2].sum() + 2.0 * f[2:-1:2].sum())
     return float(1.0 / integral)

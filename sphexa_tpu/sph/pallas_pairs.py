@@ -49,8 +49,9 @@ from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sfc.hilbert import hilbert_encode
 from sphexa_tpu.sfc.morton import morton_encode
 from sphexa_tpu.sph.kernels import (
-    sinc_dterh_u,
-    sinc_poly_coeffs,
+    dterh_poly_eval,
+    kernel_dterh_coeffs,
+    kernel_poly_coeffs,
     sinc_poly_eval,
 )
 
@@ -769,7 +770,7 @@ def pallas_density(
     global start index (for the self-pair test).
     """
     n = x.shape[0]
-    coeffs = sinc_poly_coeffs(float(const.sinc_index))
+    coeffs = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
 
     if ranges is None:
@@ -813,7 +814,7 @@ def pallas_iad(
     ``i_offset`` the slab's global start index — same contract as
     pallas_density."""
     n = x.shape[0]
-    coeffs = sinc_poly_coeffs(float(const.sinc_index))
+    coeffs = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
 
     if ranges is None:
@@ -893,7 +894,7 @@ def pallas_momentum_energy_std(
     divisions and a single rsqrt.
     """
     n = x.shape[0]
-    coeffs = sinc_poly_coeffs(float(const.sinc_index))
+    coeffs = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
     k_cour = float(const.k_cour)
 
@@ -1032,8 +1033,8 @@ def pallas_ve_def_gradh(
     candidate arrays (slab + halo annex) the ranges index into — same
     contract as pallas_density."""
     n = x.shape[0]
-    wc = sinc_poly_coeffs(float(const.sinc_index))
-    sinc_n = float(const.sinc_index)
+    wc = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
+    dc = kernel_dterh_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
 
     if ranges is None:
@@ -1046,7 +1047,7 @@ def pallas_ve_def_gradh(
         xmj = j_fields[4]
         u = geom.d2 * inv_h2
         w = _w_poly(u, wc)
-        dterh = sinc_dterh_u(u, sinc_n)
+        dterh = dterh_poly_eval(u, dc)
         mm = geom.mask
         kxs = kxs + jnp.where(mm, xmj * w, 0.0)
         who = who + jnp.where(mm, xmj * dterh, 0.0)
@@ -1096,7 +1097,7 @@ def pallas_iad_divv_curlv(
     Under shard_map, ``jdata = (x, y, z, xm, vx, vy, vz)`` supplies the
     j-side candidate arrays — same contract as pallas_density."""
     n = x.shape[0]
-    wc = sinc_poly_coeffs(float(const.sinc_index))
+    wc = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
 
     if ranges is None:
@@ -1192,7 +1193,7 @@ def pallas_av_switches(
     supplies the j-side candidate arrays — same contract as
     pallas_density."""
     n = x.shape[0]
-    wc = sinc_poly_coeffs(float(const.sinc_index))
+    wc = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
     alphamax = float(const.alphamax)
     alphamin = float(const.alphamin)
@@ -1292,7 +1293,7 @@ def pallas_momentum_energy_ve(
     arrays (derived per-j ratios are computed here); the trailing gradv
     fields are present iff avClean. Same contract as pallas_density."""
     n = x.shape[0]
-    wc = sinc_poly_coeffs(float(const.sinc_index))
+    wc = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
     k_cour = float(const.k_cour)
     at_min = float(const.at_min)

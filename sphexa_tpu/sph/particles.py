@@ -100,6 +100,8 @@ class SimConstants:
     eta_acc: float = 0.2
     max_dt_increase: float = 1.1
     sinc_index: float = 6.0
+    # kernel family (kernels.KERNEL_CHOICES; sph_kernel_tables.hpp:122-160)
+    kernel_choice: str = "sinc"
     kernel_norm: Optional[float] = None  # filled by normalized()
 
     @property
@@ -121,5 +123,5 @@ class SimConstants:
         if self.kernel_norm is not None:
             return self
         return dataclasses.replace(
-            self, kernel_norm=kernel_norm_3d(self.sinc_index)
+            self, kernel_norm=kernel_norm_3d(self.sinc_index, self.kernel_choice)
         )

@@ -1,0 +1,93 @@
+"""Continuum octree: cornerstone build from an analytic density function.
+
+Counterpart of ``cstone/tree/continuum.hpp`` (computeContinuumCsarray):
+instead of counting particles per leaf, the expected count is the density
+integral over the leaf's volume — used to pre-build trees for initial
+conditions and tests without generating particles first.
+
+The integral is estimated with a fixed 2x2x2 sub-sample per leaf (midpoint
+rule per octant), which is exact for (tri)linear densities and within a
+few percent for the smooth profiles ICs use; the count-rebalance loop
+only needs counts at bucket-size accuracy.
+"""
+
+from typing import Callable, Tuple
+
+import numpy as np
+
+from sphexa_tpu.dtypes import KEY_BITS
+from sphexa_tpu.sfc.hilbert import hilbert_decode
+from sphexa_tpu.sfc.morton import morton_decode
+from sphexa_tpu.tree.csarray import (
+    make_root_tree,
+    node_levels,
+    rebalance_tree,
+)
+
+
+def _leaf_boxes(tree: np.ndarray, box_lo, box_lengths, curve: str):
+    """(lo (L, 3), edge (L,)) AABBs of the leaves in box coordinates."""
+    import jax.numpy as jnp
+
+    starts = np.asarray(tree[:-1], np.uint64)
+    levels = node_levels(tree)
+    decode = hilbert_decode if curve == "hilbert" else morton_decode
+    ix, iy, iz = decode(jnp.asarray(starts.astype(np.uint32)))
+    cells = np.stack([np.asarray(ix), np.asarray(iy), np.asarray(iz)], axis=1)
+    shift = (KEY_BITS - levels)[:, None]
+    octant = cells >> shift
+    inv = 1.0 / (1 << levels).astype(np.float64)
+    lo = np.asarray(box_lo, np.float64)[None, :] + octant * (
+        inv[:, None] * np.asarray(box_lengths, np.float64)[None, :]
+    )
+    edge = inv[:, None] * np.asarray(box_lengths, np.float64)[None, :]
+    return lo, edge
+
+
+def continuum_counts(
+    tree: np.ndarray,
+    rho_fn: Callable,
+    box_lo,
+    box_lengths,
+    n_total: int,
+    curve: str = "hilbert",
+) -> np.ndarray:
+    """Expected particle count per leaf: N * integral(rho)/integral_total,
+    midpoint-sampled on a 2x2x2 subgrid per leaf (continuum.hpp role)."""
+    lo, edge = _leaf_boxes(tree, box_lo, box_lengths, curve)
+    vol = np.prod(edge, axis=1)
+    acc = np.zeros(len(vol), np.float64)
+    for ox in (0.25, 0.75):
+        for oy in (0.25, 0.75):
+            for oz in (0.25, 0.75):
+                p = lo + edge * np.array([ox, oy, oz])
+                acc += rho_fn(p[:, 0], p[:, 1], p[:, 2])
+    mass = acc / 8.0 * vol
+    total = mass.sum()
+    if total <= 0.0:
+        return np.zeros(len(vol), np.int64)
+    return np.round(mass / total * n_total).astype(np.int64)
+
+
+def compute_continuum_octree(
+    rho_fn: Callable,
+    box_lo,
+    box_lengths,
+    n_total: int,
+    bucket_size: int,
+    curve: str = "hilbert",
+    max_iterations: int = 64,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Converged cornerstone tree for an analytic density
+    (computeContinuumCsarray, continuum.hpp): iterate expected-count ->
+    rebalance from the root until stable."""
+    tree = make_root_tree()
+    counts = continuum_counts(tree, rho_fn, box_lo, box_lengths, n_total, curve)
+    for _ in range(max_iterations):
+        tree, converged = rebalance_tree(tree, counts, bucket_size)
+        counts = continuum_counts(
+            tree, rho_fn, box_lo, box_lengths, n_total, curve
+        )
+        if converged:
+            break
+    return tree, counts

@@ -1,0 +1,41 @@
+"""Key injection: force specific SFC keys to exist as leaf boundaries.
+
+Counterpart of ``cstone/focus/inject.hpp`` (injectKeys): guarantee
+mandatory resolution at given keys (e.g. domain boundaries, focus
+anchors) while preserving the cornerstone invariant that every leaf
+spans an aligned power-of-8 key range — each refinement therefore adds
+a full 8-child split of the containing leaf, level by level, until the
+key is a boundary.
+"""
+
+import numpy as np
+
+from sphexa_tpu.dtypes import KEY_BITS
+from sphexa_tpu.tree.csarray import KEY_RANGE, _as_keys
+
+
+def inject_keys(tree: np.ndarray, keys) -> np.ndarray:
+    """Return a valid cornerstone tree with every ``key`` on a leaf
+    boundary (injectKeys, inject.hpp:26-99)."""
+    tree = _as_keys(tree)
+    inject = np.unique(_as_keys(keys))
+    inject = inject[(inject > 0) & (inject < KEY_RANGE)]
+    boundaries = set(tree.tolist())
+
+    for k in inject.tolist():
+        if k in boundaries:
+            continue
+        # walk down from the root octant containing k; at each level add
+        # the full sibling split of the containing node (7 interior
+        # boundaries) so the power-of-8 invariant survives
+        for level in range(1, KEY_BITS + 1):
+            span = int(KEY_RANGE) >> (3 * level)
+            if span == 0:
+                break
+            node_start = (k // (span * 8)) * (span * 8)
+            for j in range(1, 8):
+                boundaries.add(node_start + j * span)
+            if k % span == 0:
+                break
+
+    return np.array(sorted(boundaries), dtype=np.uint64)

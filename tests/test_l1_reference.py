@@ -49,9 +49,9 @@ from sphexa_tpu.simulation import Simulation
 STEPS = 200
 
 
-def _run(init, side, **kw):
+def _run(init, side, prop="std", **kw):
     state, box, const = init(side)
-    sim = Simulation(state, box, const, prop="std", block=8192,
+    sim = Simulation(state, box, const, prop=prop, block=8192,
                      check_every=10, **kw)
     e0 = float(conserved_quantities(sim.state, const)["etot"])
     for _ in range(STEPS):
@@ -83,6 +83,28 @@ def test_sedov_reference_config():
     # grad-h terms (the reference std pipeline shares it; VE exists to
     # fix it, ve_def_gradh_kern.hpp). Measured 2.2e-3 over 200 steps.
     assert drift < 3e-3, drift
+
+
+def test_sedov_ve_reference_config():
+    """The flagship VE pipeline at the reference configuration (the
+    reference CI's ``sedov --ve`` run, .jenkins/reframe_ci.py:220-249),
+    with the 200-step conservation pin the std scheme cannot meet.
+
+    Measured: drift 1.22e-3 (std: 2.2e-3 — the grad-h terms nearly
+    halve the loss; avClean measures WORSE, 4.1e-3). The <1e-3 north
+    star (BASELINE.json) is NOT yet met — the window pins today's value
+    against regressions and must tighten, not loosen.
+    L1_rho measures 0.354 (std: 0.166): the AV-switch scheme starting
+    from alpha_min under-dissipates the initial blast; the reference CI
+    asserts no VE L1 reference either (its --ve runs are smoke-only).
+    """
+    sim, fields, drift = _run(init_sedov, 50, prop="ve")
+    t = float(sim.state.ttot)
+    sol = sedov_solution(fields["r"], time=t, eblast=1.0,
+                         gamma=sim.const.gamma)
+    l1_rho = l1_error(fields["rho"], sol["rho"])
+    assert 0.25 < l1_rho < 0.45, l1_rho
+    assert drift < 2e-3, drift
 
 
 def test_noh_reference_config():

@@ -1,0 +1,129 @@
+"""Spherical multipoles with selectable order P (the reference's EXAFMM
+accuracy knob, ryoanji/nbody/kernel.hpp): operator identities + the
+order-4-beats-quadrupole accuracy pin vs direct summation."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sphexa_tpu.gravity import spherical as sp
+
+
+def _cloud(n=64, seed=0, spread=0.3):
+    rng = np.random.default_rng(seed)
+    pos = rng.normal(0, spread, (n, 3))
+    m = rng.uniform(0.5, 1.5, n)
+    return (jnp.asarray(pos[:, 0]), jnp.asarray(pos[:, 1]),
+            jnp.asarray(pos[:, 2]), jnp.asarray(m))
+
+
+def _direct_phi(x, y, z, m, px, py, pz):
+    dx = px - np.asarray(x)
+    dy = py - np.asarray(y)
+    dz = pz - np.asarray(z)
+    return float(np.sum(np.asarray(m) / np.sqrt(dx**2 + dy**2 + dz**2)))
+
+
+@pytest.mark.parametrize("p", [2, 3, 4, 5])
+def test_expansion_converges_to_direct(p):
+    """phi from P2M+M2P converges to the direct sum with growing P."""
+    x, y, z, m = _cloud()
+    edges = jnp.asarray([0, 64], jnp.int32)
+    center = jnp.zeros((1, 3))
+    M = sp.p2m(x, y, z, m, center, edges, p)
+    target = (2.0, 1.5, 1.8)
+    phi = float(sp.potential(
+        jnp.asarray([target[0]]), jnp.asarray([target[1]]),
+        jnp.asarray([target[2]]), M[0], p,
+    )[0])
+    exact = _direct_phi(x, y, z, m, *target)
+    rel = abs(phi - exact) / abs(exact)
+    # geometric convergence in (spread/r)^P
+    assert rel < (0.45) ** (p - 1), (p, rel)
+
+
+def test_m2m_preserves_far_potential():
+    """Translating the expansion center must not change the far field."""
+    p = 4
+    x, y, z, m = _cloud(seed=3)
+    edges = jnp.asarray([0, 64], jnp.int32)
+    c1 = jnp.zeros((1, 3))
+    M1 = sp.p2m(x, y, z, m, c1, edges, p)
+    # rebuild about a shifted center directly, and via M2M translation
+    c2 = jnp.asarray([[0.2, -0.1, 0.15]])
+    M2_direct = sp.p2m(x, y, z, m, c2, edges, p)
+    d = c1 - c2  # child center - parent center
+    M2_trans = sp.m2m(M1, d, p)
+    tx = jnp.asarray([3.0])
+    ty = jnp.asarray([0.5])
+    tz = jnp.asarray([-2.0])
+    phi_a = float(sp.potential(tx - c2[0, 0], ty - c2[0, 1], tz - c2[0, 2],
+                               M2_direct[0], p)[0])
+    phi_b = float(sp.potential(tx - c2[0, 0], ty - c2[0, 1], tz - c2[0, 2],
+                               M2_trans[0], p)[0])
+    np.testing.assert_allclose(phi_b, phi_a, rtol=2e-5)
+
+
+def test_m2p_autodiff_force_matches_fd():
+    p = 4
+    x, y, z, m = _cloud(seed=5)
+    edges = jnp.asarray([0, 64], jnp.int32)
+    center = jnp.zeros((1, 3))
+    M = sp.p2m(x, y, z, m, center, edges, p)
+    mask = jnp.asarray([True])
+    tx, ty, tz = jnp.asarray([2.2]), jnp.asarray([-1.1]), jnp.asarray([1.4])
+    ax, ay, az, phi = sp.m2p(tx, ty, tz, center, M, mask, p)
+    eps = 1e-3
+    phi_p = sp.m2p(tx + eps, ty, tz, center, M, mask, p)[3]
+    phi_m = sp.m2p(tx - eps, ty, tz, center, M, mask, p)[3]
+    fd = -(float(phi_p[0]) - float(phi_m[0])) / (2 * eps)
+    np.testing.assert_allclose(float(ax[0]), fd, rtol=1e-3)
+
+
+def test_order4_beats_quadrupole_in_gravity_solver():
+    """End-to-end accuracy knob: Barnes-Hut forces at equal theta with
+    spherical order-4 multipoles come closer to direct summation than
+    the cartesian quadrupole (VERDICT r2 #6 done-criterion)."""
+    import dataclasses
+
+    import jax
+
+    from sphexa_tpu.gravity.direct import direct_gravity
+    from sphexa_tpu.gravity.traversal import GravityConfig, compute_gravity
+    from sphexa_tpu.init import init_evrard
+    from sphexa_tpu.propagator import _sort_by_keys
+    from sphexa_tpu.sfc.box import make_global_box
+    from sphexa_tpu.simulation import Simulation
+
+    state, box, const = init_evrard(12, overrides={"G": 1.0})
+    sim = Simulation(state, box, const, prop="nbody", block=512)
+    cfg = sim._cfg
+    gbox = make_global_box(state.x, state.y, state.z, box)
+    sstate, keys, _ = _sort_by_keys(state, gbox, cfg.curve)
+
+    adx, ady, adz, _ = direct_gravity(
+        sstate.x, sstate.y, sstate.z, sstate.m, sstate.h
+    )
+    aref = np.sqrt(np.asarray(adx)**2 + np.asarray(ady)**2
+                   + np.asarray(adz)**2)
+
+    def err(order):
+        gcfg = dataclasses.replace(
+            cfg.gravity, G=1.0, theta=0.9, multipole_order=order,
+            use_pallas=False,
+        )
+        ax, ay, az, _, _ = compute_gravity(
+            sstate.x, sstate.y, sstate.z, sstate.m, sstate.h, keys, gbox,
+            sim._gtree, cfg.grav_meta, gcfg,
+        )
+        dx = np.asarray(ax) - np.asarray(adx)
+        dy = np.asarray(ay) - np.asarray(ady)
+        dz = np.asarray(az) - np.asarray(adz)
+        return float(np.mean(np.sqrt(dx**2 + dy**2 + dz**2) / (aref + 1e-12)))
+
+    e_quad = err(0)  # cartesian quadrupole path
+    e_p4 = err(4)
+    e_p6 = err(6)
+    assert e_p4 < e_quad, (e_p4, e_quad)
+    assert e_p6 < e_p4, (e_p6, e_p4)

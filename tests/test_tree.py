@@ -95,3 +95,68 @@ class TestDecomposition:
         bins, per_rank = make_sfc_assignment(keys, 4)
         assert per_rank.sum() == 4096
         assert per_rank.min() > 0
+
+
+class TestContinuumTree:
+    """Octree from an analytic density (cstone/tree/continuum.hpp)."""
+
+    def test_uniform_density_balanced(self):
+        from sphexa_tpu.tree.continuum import compute_continuum_octree
+
+        tree, counts = compute_continuum_octree(
+            lambda x, y, z: np.ones_like(x),
+            (0.0, 0.0, 0.0), (1.0, 1.0, 1.0),
+            n_total=8**4, bucket_size=64,
+        )
+        from sphexa_tpu.tree.csarray import node_levels
+
+        levels = node_levels(tree)
+        # uniform density -> uniform refinement, all counts <= bucket
+        assert levels.min() == levels.max()
+        assert counts.max() <= 64
+
+    def test_peaked_density_refines_centrally(self):
+        from sphexa_tpu.tree.continuum import compute_continuum_octree
+        from sphexa_tpu.tree.csarray import node_levels
+
+        def rho(x, y, z):
+            r2 = (x - 0.5) ** 2 + (y - 0.5) ** 2 + (z - 0.5) ** 2
+            return np.exp(-r2 / 0.01)
+
+        tree, counts = compute_continuum_octree(
+            rho, (0.0, 0.0, 0.0), (1.0, 1.0, 1.0),
+            n_total=100000, bucket_size=64,
+        )
+        levels = node_levels(tree)
+        # the density peak demands deeper leaves than the empty corners
+        assert levels.max() - levels.min() >= 2
+        assert counts.max() <= 64 * 2  # rounding slack
+
+
+class TestInjectKeys:
+    """Mandatory-resolution key injection (cstone/focus/inject.hpp)."""
+
+    def test_injected_keys_become_boundaries(self):
+        from sphexa_tpu.tree.csarray import KEY_RANGE, make_uniform_tree, node_levels
+        from sphexa_tpu.tree.inject import inject_keys
+
+        tree = make_uniform_tree(1)  # 8 leaves
+        want = np.array([KEY_RANGE // 64 * 3, KEY_RANGE // 512 * 100],
+                        dtype=np.uint64)
+        out = inject_keys(tree, want)
+        assert set(want.tolist()) <= set(out.tolist())
+        # invariant: every leaf spans an aligned power-of-8 range
+        spans = np.diff(out.astype(np.uint64))
+        levels = node_levels(out)
+        assert (out[:-1] % spans == 0).all()
+        # spans must be exact powers of 8
+        l = np.log2(spans.astype(np.float64)) / 3.0
+        assert np.allclose(l, np.round(l))
+
+    def test_existing_boundary_noop(self):
+        from sphexa_tpu.tree.csarray import make_uniform_tree
+        from sphexa_tpu.tree.inject import inject_keys
+
+        tree = make_uniform_tree(2)
+        out = inject_keys(tree, tree[3:5])
+        np.testing.assert_array_equal(out, tree)

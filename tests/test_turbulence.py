@@ -157,3 +157,35 @@ class TestTurbVePropagator:
         assert float(e1["ecin"]) > 0
         for f in ("x", "vx", "temp", "h"):
             assert np.all(np.isfinite(np.asarray(getattr(sim.state, f)))), f
+
+
+def test_spect_form_2_power_law_modes():
+    """stSpectForm=2: power-law random-angle shell sampling
+    (create_modes.hpp:179-238)."""
+    from sphexa_tpu.sph.hydro_turb import create_stirring_modes
+
+    cfg, st = create_stirring_modes(
+        lbox=1.0, spect_form=2, seed=251299,
+        power_law_exp=5.0 / 3.0, angles_exp=2.0,
+    )
+    m = np.asarray(st.modes)
+    a = np.asarray(st.amplitudes)
+    assert m.shape[0] > 10
+    k = np.sqrt((m**2).sum(axis=1))
+    twopi = 2.0 * np.pi
+    assert (k >= twopi * (1 - 1e-6)).all() and (k <= 3 * twopi * (1 + 1e-6)).all()
+    assert (a > 0).all() and np.isfinite(a).all()
+    # amplitudes follow the power law trend modulo the angle correction:
+    # higher-k shells are sampled, none degenerate
+    assert np.unique(np.round(k / twopi).astype(int)).size >= 2
+
+
+def test_spect_form_2_runs_a_step():
+    from sphexa_tpu.init import init_turbulence
+    from sphexa_tpu.simulation import Simulation
+
+    state, box, const = init_turbulence(8)
+    sim = Simulation(state, box, const, prop="turb-ve",
+                     turb_settings={"stSpectForm": 2}, block=512)
+    d = sim.step()
+    assert np.isfinite(np.asarray(sim.state.vx)).all()
