@@ -1,0 +1,63 @@
+"""Precision pins for the 400^3 target scale (VERDICT r2 weak #8 /
+SURVEY §7): the integer-key + f32-coordinate policy must resolve the
+target problem's particle spacing with margin."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from sphexa_tpu.dtypes import KEY_BITS
+from sphexa_tpu.sfc.box import BoundaryType, Box
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+SIDE = 400  # BASELINE.json target configuration (v5e-16, 64M particles)
+
+
+def test_f32_coordinates_resolve_400cubed_spacing():
+    """f32 position quantum is ~4 decades below the lattice spacing."""
+    spacing = 1.0 / SIDE
+    worst = np.max(np.abs(np.float64(np.float32(0.5)) - 0.5) + np.spacing(
+        np.float32(0.5)
+    ))
+    assert worst < 1e-4 * spacing
+
+
+def test_key_grid_finer_than_400cubed_spacing():
+    """The 30-bit key grid (level 10) subdivides the target spacing, so
+    the SFC sort fully orders a 400^3 lattice (cell edge 1/1024 < 1/400)
+    and level <= 10 covers any occupancy-chosen search grid."""
+    assert (1 << KEY_BITS) > SIDE
+
+
+def test_keys_order_consistently_with_f64_at_scale():
+    """Hilbert keys computed from f32 coordinates reproduce the f64 cell
+    assignment for ~1e5 samples of the 400^3-scale box."""
+    rng = np.random.default_rng(0)
+    n = 100_000
+    pos64 = rng.uniform(-0.5, 0.5, (n, 3))
+    # snap to the 400^3 lattice +- 10% jitter (the IC geometry)
+    pos64 = np.round(pos64 * SIDE) / SIDE + rng.uniform(
+        -0.1 / SIDE, 0.1 / SIDE, (n, 3)
+    )
+    box = Box.create(-0.5, 0.5, boundary=BoundaryType.periodic)
+    k32 = np.asarray(compute_sfc_keys(
+        jnp.asarray(pos64[:, 0], jnp.float32),
+        jnp.asarray(pos64[:, 1], jnp.float32),
+        jnp.asarray(pos64[:, 2], jnp.float32), box,
+    ))
+    # f64 reference: quantize in float64 then encode the same grid cells
+    lo, lengths = -0.5, 1.0
+    ncell = 1 << KEY_BITS
+    cells64 = np.clip(
+        ((pos64 - lo) / lengths * ncell).astype(np.int64), 0, ncell - 1
+    )
+    cells32 = np.clip(
+        ((np.float32(pos64).astype(np.float64) - lo) / lengths * ncell
+         ).astype(np.int64), 0, ncell - 1,
+    )
+    # f32 rounding may shift a coordinate across a cell edge only within
+    # one quantum — never more than one cell, and for <0.1% of samples
+    diff = np.abs(cells64 - cells32)
+    assert diff.max() <= 1
+    assert (diff > 0).mean() < 1e-3
+    assert np.unique(k32).size > 0.9 * n  # keys resolve distinct cells
