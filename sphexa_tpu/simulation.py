@@ -289,6 +289,7 @@ class Simulation:
             int(diagnostics["m2p_max"]) > g.m2p_cap
             or int(diagnostics["p2p_max"]) > g.p2p_cap
             or int(diagnostics["leaf_occ"]) > g.leaf_cap
+            or int(diagnostics.get("c_max", 0)) > g.super_cap
         )
 
     def _config_still_valid(self, diagnostics) -> bool:
