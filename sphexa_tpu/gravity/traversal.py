@@ -227,8 +227,56 @@ def compute_multipoles(
     return node_mass, node_com, node_q, edges
 
 
+def compute_multipoles_sharded(
+    x, y, z, m, local_keys, tree: GravityTree, meta: GravityTreeMeta,
+    axis: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Distributed multipole upsweep under shard_map — the
+    global_multipole.hpp:44-73 allreduce analog.
+
+    Each shard contributes the PARTIAL leaf sums of its slab rows (leaf
+    row ranges clipped to the slab; leaves are key ranges, so membership
+    needs only the local keys), one psum replicates the (L, k) leaf
+    payloads, and the level-by-level M2M upsweep runs replicated on the
+    (small) tree. Comm is O(tree), never O(N) — no particle gather.
+    Returns the compute_multipoles ORDER-0 contract (cartesian
+    quadrupole; compute_gravity guards order>0) with GLOBAL row edges.
+    """
+    lk = tree.leaf_keys
+    num_l, num_n = meta.num_leaves, meta.num_nodes
+    S = x.shape[0]
+    k = jax.lax.axis_index(axis)
+    pos_local = jnp.searchsorted(local_keys, lk, side="left").astype(jnp.int32)
+    edges = jax.lax.psum(pos_local, axis)  # global leaf boundary rows
+    e_clip = jnp.clip(edges - k * S, 0, S)
+    pleaf = (
+        jnp.searchsorted(lk, local_keys, side="right").astype(jnp.int32) - 1
+    )
+
+    w = jnp.stack([m, m * x, m * y, m * z], axis=1)
+    leaf_w = jax.lax.psum(mp.edge_segment_sum(w, e_clip), axis)  # (L, 4)
+    node_w = jnp.zeros((num_n, 4), leaf_w.dtype).at[tree.node_of_leaf].set(leaf_w)
+    for s_, e_ in reversed(meta.level_ranges[1:]):
+        node_w = node_w.at[tree.parent[s_:e_]].add(node_w[s_:e_])
+    node_mass = node_w[:, 0]
+    node_com = node_w[:, 1:4] / jnp.maximum(node_mass, 1e-30)[:, None]
+
+    leaf_com = node_com[tree.node_of_leaf]
+    leaf_q = jax.lax.psum(
+        mp.p2m_leaf(x, y, z, m, pleaf, leaf_com, num_l, edges=e_clip), axis
+    )
+    node_q = jnp.zeros((num_n, 7), leaf_q.dtype).at[tree.node_of_leaf].set(leaf_q)
+    for s_, e_ in reversed(meta.level_ranges[1:]):
+        par = tree.parent[s_:e_]
+        d = node_com[par] - node_com[s_:e_]
+        node_q = node_q.at[par].add(
+            mp.m2m_shift(node_q[s_:e_], node_mass[s_:e_], d)
+        )
+    return node_mass, node_com, node_q, edges
+
+
 def _pallas_p2p(x, y, z, m, h, shift, allow_self, cfg: GravityConfig,
-                starts, lens):
+                starts, lens, jdata=None, i_offset=0):
     """Near-field P2P through the streamed pair engine.
 
     ``starts``/``lens`` are the per-block near-leaf ranges from the MAC
@@ -237,6 +285,11 @@ def _pallas_p2p(x, y, z, m, h, shift, allow_self, cfg: GravityConfig,
     with gap=0 ONLY: a bridged gap would stream particles of leaves whose
     mass already arrives via M2P (no distance cutoff masks them away),
     double-counting. Returns (ax, ay, az, phi), each (NB*block,).
+
+    Under shard_map, ``jdata = (x, y, z, m, h)`` supplies the j-side
+    candidate arrays (slab + halo annex) the (pre-localized) ranges
+    index into, and ``i_offset`` places the local targets in that index
+    space — the same contract as the SPH engine ops.
     """
     from sphexa_tpu.neighbors.cell_list import NeighborConfig
     from sphexa_tpu.sph import pallas_pairs as pp
@@ -294,17 +347,19 @@ def _pallas_p2p(x, y, z, m, h, shift, allow_self, cfg: GravityConfig,
 
     i_fields = [blocked(x, shift[0]), blocked(y, shift[1]),
                 blocked(z, shift[2]), blocked(h, 0.0)]
-    jp = pp.pack_j_fields((x, y, z, m, h), nbr.dma_cap)
-    ax, ay, az, phi, _nc = engine(ranges, i_fields, jp, 0, allow_self)
+    jp = pp.pack_j_fields(jdata or (x, y, z, m, h), nbr.dma_cap)
+    ax, ay, az, phi, _nc = engine(ranges, i_fields, jp, i_offset, allow_self)
     f = lambda a: a.reshape(-1)
     return f(ax), f(ay), f(az), f(phi)
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "cfg", "with_phi"))
+@functools.partial(jax.jit,
+                   static_argnames=("meta", "cfg", "with_phi", "shard"))
 def compute_gravity(
     x, y, z, m, h, sorted_keys, box: Box,
     tree: GravityTree, meta: GravityTreeMeta, cfg: GravityConfig,
     shift=None, allow_self=None, with_phi: bool = False, mp_cache=None,
+    shard=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
     """Gravitational acceleration + potential for all (SFC-sorted) particles.
 
@@ -321,7 +376,23 @@ def compute_gravity(
     particle does interact with its own periodic image. Both are traced so
     the Ewald replica loop compiles this function once.
     ``mp_cache``: optional precomputed compute_multipoles result.
+    ``shard``: (axis, P, Wmax) when running INSIDE shard_map on a local
+    slab — x/y/z/... are then the slab, mp_cache must come from
+    compute_multipoles_sharded (global edges), and the near field
+    fetches remote leaf rows through the windowed halo exchange
+    (parallel/exchange.py) instead of indexing a global array. egrav and
+    diagnostics are returned per-shard (the caller psums/pmaxes).
     """
+    if shard is not None and not cfg.use_pallas:
+        raise ValueError("sharded gravity needs the engine near field "
+                         "(cfg.use_pallas=True; interpret mode off-TPU)")
+    if shard is not None and cfg.multipole_order > 0:
+        raise ValueError("sharded gravity supports the cartesian "
+                         "quadrupole only (compute_multipoles_sharded "
+                         "has no spherical upsweep yet)")
+    if shard is not None and mp_cache is None:
+        raise ValueError("sharded gravity needs mp_cache from "
+                         "compute_multipoles_sharded")
     n = x.shape[0]
     num_n = meta.num_nodes
     order = cfg.multipole_order
@@ -550,12 +621,44 @@ def compute_gravity(
         return jax.vmap(one_block)(bidx, bn)
 
     out = jax.lax.map(one_chunk, (idx, bnum))
+    escaped = jnp.asarray(False)
     if cfg.use_pallas:
         ax, ay, az, phi, m2p_n, p2p_n, p2p_starts, p2p_lens = out
+        starts2 = p2p_starts.reshape(-1, cfg.p2p_cap)
+        lens2 = p2p_lens.reshape(-1, cfg.p2p_cap)
+        jd = None
+        if shard is not None:
+            # near-field halos: leaf row ranges are GLOBAL rows; fetch
+            # the remote ones through per-peer windows (the same
+            # exchange the SPH stages ride; runs escaping their window
+            # flip the p2p sentinel so the driver re-sizes). The caller
+            # clamps Wmax <= slab rows (see _gravity_sharded_stage).
+            from sphexa_tpu.parallel import exchange as ex
+            from sphexa_tpu.sph.pallas_pairs import GroupRanges
+
+            axis, P_, Wmax = shard
+            kk = jax.lax.axis_index(axis)
+            zf = jnp.zeros_like(starts2, dtype=jnp.float32)
+            pr = GroupRanges(
+                starts=starts2, lens=lens2, shift_x=zf, shift_y=zf,
+                shift_z=zf,
+                ncells=jnp.zeros(starts2.shape[0], jnp.int32),  # recomputed
+                occupancy=jnp.int32(0),
+                boxl=jnp.full((3,), 1e30, jnp.float32),
+            )
+            lranges, bounds, escaped = ex.localize_ranges(
+                pr, n, P_, Wmax, kk, axis
+            )
+            halo = ex.serve_windows((x, y, z, m, h), bounds, n, Wmax,
+                                    P_, kk, axis)
+            jd = tuple(
+                jnp.concatenate([o, a])
+                for o, a in zip((x, y, z, m, h), halo)
+            )
+            starts2, lens2 = lranges.starts, lranges.lens
         pax, pay, paz, pphi = _pallas_p2p(
             x, y, z, m, h, shift, allow_self, cfg,
-            p2p_starts.reshape(-1, cfg.p2p_cap),
-            p2p_lens.reshape(-1, cfg.p2p_cap),
+            starts2, lens2, jdata=jd,
         )
         blkpad = ax.reshape(-1).shape[0]
         ax = ax.reshape(-1) + pax[:blkpad]
@@ -577,9 +680,17 @@ def compute_gravity(
         evals = nsc * chunk * num_n + m2p_n.size * scap
     else:
         evals = m2p_n.size * num_n
+    p2p_hw = jnp.max(p2p_n)
+    if shard is not None:
+        # an escaped near-field run means truncated candidates: the
+        # SHARED overflow contract encodes it as a p2p overflow (and
+        # pmaxes) so the driver re-sizes the halo window
+        from sphexa_tpu.parallel.exchange import fold_escape_sentinel
+
+        p2p_hw = fold_escape_sentinel(p2p_hw, escaped, cfg.p2p_cap, shard[0])
     diagnostics = {
         "m2p_max": jnp.max(m2p_n),
-        "p2p_max": jnp.max(p2p_n),
+        "p2p_max": p2p_hw,
         "leaf_occ": leaf_occ,
         # superblock candidate-list high water (cap guard; 0 = dense path)
         "c_max": c_max if sf > 0 else jnp.int32(0),

@@ -83,11 +83,14 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
                                       halo_window=halo_window)
         else:
             cfg = dataclasses.replace(cfg, backend="xla")
-    if cfg.gravity is not None and cfg.gravity.use_pallas:
-        # gravity runs in the GSPMD region (outside the pair-op
-        # shard_map), where a Mosaic custom call has no partitioning
-        # rule — keep the XLA near field until gravity gets its own
-        # shard wrapper
+    if (cfg.gravity is not None and cfg.gravity.use_pallas
+            and (cfg.shard_axis is None or cfg.ewald is not None)):
+        # on the GSPMD path (nbody/turb/cooling/xla steps) gravity runs
+        # outside any shard_map, where a Mosaic custom call has no
+        # partitioning rule — fall back to the XLA near field there. The
+        # fast-path steps instead run _gravity_sharded_stage (distributed
+        # upsweep + windowed near-field halos) with the engine inside
+        # shard_map.
         cfg = dataclasses.replace(
             cfg, gravity=dataclasses.replace(cfg.gravity, use_pallas=False)
         )

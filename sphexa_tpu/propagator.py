@@ -115,6 +115,45 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
     return permute_tree(state), sorted_keys, permute_tree(aux)
 
 
+def _gravity_sharded_stage(state, box, cfg, gtree, keys):
+    """Distributed gravity under shard_map: psum multipole upsweep (the
+    global_multipole.hpp allreduce analog — O(tree) comm, no particle
+    replication), per-shard MAC/M2P on the replicated coarse tree, and
+    the near field through the windowed halo exchange."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec
+    from sphexa_tpu.gravity.traversal import compute_multipoles_sharded
+
+    axis = cfg.shard_axis
+    P = cfg.mesh.shape[axis]
+    S_shard = state.x.shape[0] // P
+    Wmax = min(cfg.halo_window, S_shard) or S_shard
+    gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g, use_pallas=True)
+
+    def stage(box, keys, x, y, z, m, h):
+        mpc = compute_multipoles_sharded(
+            x, y, z, m, keys, gtree, cfg.grav_meta, axis
+        )
+        gx, gy, gz, egrav, diag = compute_gravity(
+            x, y, z, m, h, keys, box, gtree, cfg.grav_meta, gcfg,
+            mp_cache=mpc, shard=(axis, P, Wmax),
+        )
+        egrav = jax.lax.psum(egrav, axis)
+        diag = {k: jax.lax.pmax(v, axis) for k, v in diag.items()}
+        return gx, gy, gz, egrav, diag
+
+    Pp, Pr = PartitionSpec(axis), PartitionSpec()
+    dspec = {"m2p_max": Pr, "p2p_max": Pr, "leaf_occ": Pr, "c_max": Pr,
+             "mac_work_ratio": Pr}
+    return shard_map(
+        stage,
+        mesh=cfg.mesh,
+        in_specs=(Pr, Pp, Pp, Pp, Pp, Pp, Pp),
+        out_specs=(Pp, Pp, Pp, Pr, dspec),
+        check_vma=False,
+    )(box, keys, state.x, state.y, state.z, state.m, state.h)
+
+
 def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
     """Self-gravity coupling: Barnes-Hut accel added to the hydro accel.
 
@@ -123,13 +162,18 @@ def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
     SFC-sorted arrays the step just produced. Returns updated accels,
     egrav, the acceleration dt candidate, and solver diagnostics.
     """
-    gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g)
     if cfg.ewald is not None:
+        gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g)
         gx, gy, gz, egrav, gdiag = compute_gravity_ewald(
             state.x, state.y, state.z, state.m, state.h, keys, box,
             gtree, cfg.grav_meta, gcfg, cfg.ewald,
         )
+    elif cfg.shard_axis is not None:
+        gx, gy, gz, egrav, gdiag = _gravity_sharded_stage(
+            state, box, cfg, gtree, keys
+        )
     else:
+        gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g)
         gx, gy, gz, egrav, gdiag = compute_gravity(
             state.x, state.y, state.z, state.m, state.h, keys, box,
             gtree, cfg.grav_meta, gcfg,
