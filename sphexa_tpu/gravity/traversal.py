@@ -199,11 +199,7 @@ def compute_multipoles(
     # edges (mp.edge_segment_sum) — not TPU-serializing scatter-adds.
     w = jnp.stack([m, m * x, m * y, m * z], axis=1)  # (n, 4)
     leaf_w = mp.edge_segment_sum(w, edges)  # (L, 4)
-    node_w = jnp.zeros((num_n, 4), leaf_w.dtype).at[tree.node_of_leaf].set(leaf_w)
-    for s, e in reversed(meta.level_ranges[1:]):
-        node_w = node_w.at[tree.parent[s:e]].add(node_w[s:e])
-    node_mass = node_w[:, 0]
-    node_com = node_w[:, 1:4] / jnp.maximum(node_mass, 1e-30)[:, None]
+    node_mass, node_com = _upsweep_mass_com(leaf_w, tree, meta)
 
     if order > 0:
         from sphexa_tpu.gravity import spherical as sp
@@ -219,12 +215,32 @@ def compute_multipoles(
     leaf_com = node_com[tree.node_of_leaf]
     leaf_q = mp.p2m_leaf(x, y, z, m, pleaf, leaf_com, num_l,
                          edges=edges)  # (L, 7)
+    node_q = _upsweep_quadrupoles(leaf_q, node_mass, node_com, tree, meta)
+    return node_mass, node_com, node_q, edges
+
+
+def _upsweep_mass_com(leaf_w, tree, meta):
+    """Shared monopole/center-of-mass upsweep from (L, 4) leaf payloads
+    (single-device and distributed callers MUST use the same loops so
+    their multipoles cannot diverge)."""
+    num_n = meta.num_nodes
+    node_w = jnp.zeros((num_n, 4), leaf_w.dtype).at[tree.node_of_leaf].set(leaf_w)
+    for s, e in reversed(meta.level_ranges[1:]):
+        node_w = node_w.at[tree.parent[s:e]].add(node_w[s:e])
+    node_mass = node_w[:, 0]
+    node_com = node_w[:, 1:4] / jnp.maximum(node_mass, 1e-30)[:, None]
+    return node_mass, node_com
+
+
+def _upsweep_quadrupoles(leaf_q, node_mass, node_com, tree, meta):
+    """Shared M2M quadrupole upsweep from (L, 7) leaf payloads."""
+    num_n = meta.num_nodes
     node_q = jnp.zeros((num_n, 7), leaf_q.dtype).at[tree.node_of_leaf].set(leaf_q)
     for s, e in reversed(meta.level_ranges[1:]):
         par = tree.parent[s:e]
         d = node_com[par] - node_com[s:e]
         node_q = node_q.at[par].add(mp.m2m_shift(node_q[s:e], node_mass[s:e], d))
-    return node_mass, node_com, node_q, edges
+    return node_q
 
 
 def compute_multipoles_sharded(
@@ -255,23 +271,13 @@ def compute_multipoles_sharded(
 
     w = jnp.stack([m, m * x, m * y, m * z], axis=1)
     leaf_w = jax.lax.psum(mp.edge_segment_sum(w, e_clip), axis)  # (L, 4)
-    node_w = jnp.zeros((num_n, 4), leaf_w.dtype).at[tree.node_of_leaf].set(leaf_w)
-    for s_, e_ in reversed(meta.level_ranges[1:]):
-        node_w = node_w.at[tree.parent[s_:e_]].add(node_w[s_:e_])
-    node_mass = node_w[:, 0]
-    node_com = node_w[:, 1:4] / jnp.maximum(node_mass, 1e-30)[:, None]
+    node_mass, node_com = _upsweep_mass_com(leaf_w, tree, meta)
 
     leaf_com = node_com[tree.node_of_leaf]
     leaf_q = jax.lax.psum(
         mp.p2m_leaf(x, y, z, m, pleaf, leaf_com, num_l, edges=e_clip), axis
     )
-    node_q = jnp.zeros((num_n, 7), leaf_q.dtype).at[tree.node_of_leaf].set(leaf_q)
-    for s_, e_ in reversed(meta.level_ranges[1:]):
-        par = tree.parent[s_:e_]
-        d = node_com[par] - node_com[s_:e_]
-        node_q = node_q.at[par].add(
-            mp.m2m_shift(node_q[s_:e_], node_mass[s_:e_], d)
-        )
+    node_q = _upsweep_quadrupoles(leaf_q, node_mass, node_com, tree, meta)
     return node_mass, node_com, node_q, edges
 
 

@@ -136,6 +136,7 @@ def init_file_split(
         m=jnp.asarray(replicate(state.m, 1.0 / num_splits)),
         h=jnp.asarray(replicate(state.h, inv_cbrt)),
         temp=jnp.asarray(replicate(state.temp)),
+        temp_lo=jnp.zeros(n1, jnp.float32),
         alpha=jnp.asarray(replicate(state.alpha)),
         du=jnp.zeros(n1, jnp.float32),
         du_m1=jnp.zeros(n1, jnp.float32),

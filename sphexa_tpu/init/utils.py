@@ -57,7 +57,7 @@ def build_state(
         x=f32(x), y=f32(y), z=f32(z),
         x_m1=vx * min_dt, y_m1=vy * min_dt, z_m1=vz * min_dt,
         vx=vx, vy=vy, vz=vz,
-        h=f32(h), m=f32(m), temp=f32(temp),
+        h=f32(h), m=f32(m), temp=f32(temp), temp_lo=zeros,
         du=zeros, du_m1=zeros, alpha=f32(alpha),
         ttot=jnp.float32(0.0),
         min_dt=jnp.float32(min_dt),

@@ -222,6 +222,9 @@ def read_snapshot_full(
     f32 = lambda k: jnp.asarray(fields[k], jnp.float32)
     state = ParticleState(
         **{f: f32(f) for f in CONSERVED_FIELDS},
+        # the energy-update compensation carry is not serialized (it is
+        # < 1 ulp of temp); restarting resets it
+        temp_lo=jnp.zeros_like(jnp.asarray(fields["temp"], jnp.float32)),
         ttot=jnp.float32(attrs["time"]),
         min_dt=jnp.float32(attrs["minDt"]),
         min_dt_m1=jnp.float32(attrs["minDt_m1"]),

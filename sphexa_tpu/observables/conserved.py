@@ -29,7 +29,13 @@ def conserved_quantities(
     m = state.m
     dt = _acc_dtype()
     ekin = 0.5 * jnp.sum(m * (state.vx**2 + state.vy**2 + state.vz**2), dtype=dt)
-    eint = jnp.sum(const.cv * state.temp * m, dtype=dt)
+    # temp_lo is the energy update's compensation carry (two-sum,
+    # positions.energy_update): the true internal energy includes it.
+    # Cast BEFORE adding — in f32 the sub-ulp carry would round away.
+    eint = jnp.sum(
+        const.cv * m.astype(dt)
+        * (state.temp.astype(dt) + state.temp_lo.astype(dt))
+    )
     etot = ekin + eint + egrav
 
     linmom_x = jnp.sum(m * state.vx, dtype=dt)

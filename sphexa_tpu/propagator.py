@@ -193,15 +193,18 @@ def _integrate_and_finish(
     through here (the analog of the common trailing sequence of
     std_hydro.hpp/ve_hydro.hpp step())."""
     fields = (state.x, state.y, state.z, state.x_m1, state.y_m1, state.z_m1,
-              state.vx, state.vy, state.vz, state.h, state.temp, du, state.du_m1)
-    (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, du, du_m1) = compute_positions(
+              state.vx, state.vy, state.vz, state.h, state.temp,
+              state.temp_lo, du, state.du_m1)
+    (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, temp_lo, du,
+     du_m1) = compute_positions(
         fields, ax, ay, az, dt, state.min_dt, box, const
     )
     new_h = update_h(const.ng0, nc + 1, h) if update_smoothing else h
     new_state = dataclasses.replace(
         state,
         x=nx, y=ny, z=nz, x_m1=dxm, y_m1=dym, z_m1=dzm,
-        vx=vx, vy=vy, vz=vz, h=new_h, temp=temp, du=du, du_m1=du_m1,
+        vx=vx, vy=vy, vz=vz, h=new_h, temp=temp, temp_lo=temp_lo, du=du,
+        du_m1=du_m1,
         ttot=state.ttot + dt, min_dt=dt, min_dt_m1=state.min_dt,
         **(extra or {}),
     )

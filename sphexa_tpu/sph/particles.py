@@ -47,6 +47,14 @@ class ParticleState:
     h: jax.Array
     m: jax.Array
     temp: jax.Array
+    # compensation carry of the energy update (two-sum): the true
+    # internal energy is cv*(temp + temp_lo). The reference integrates u
+    # in DOUBLE (positions.hpp:54-63 'double u_new'); on TPU the f32
+    # accumulation would swallow increments below u*eps (~2e-3 relative
+    # over 200 Sedov steps — the round-2/3 std drift), so the lost low
+    # bits ride along explicitly. Physics reads temp (error <= 1 ulp);
+    # conservation diagnostics add the carry back.
+    temp_lo: jax.Array
     du: jax.Array
     du_m1: jax.Array
     alpha: jax.Array
@@ -65,7 +73,7 @@ class ParticleState:
         s = lambda v: jnp.asarray(v, dtype)
         return ParticleState(
             x=f(), y=f(), z=f(), x_m1=f(), y_m1=f(), z_m1=f(),
-            vx=f(), vy=f(), vz=f(), h=f(), m=f(), temp=f(),
+            vx=f(), vy=f(), vz=f(), h=f(), m=f(), temp=f(), temp_lo=f(),
             du=f(), du_m1=f(), alpha=f(),
             ttot=s(0.0), min_dt=s(1e-12), min_dt_m1=s(1e-12),
         )

@@ -54,17 +54,33 @@ def fixed_boundary_frozen(x, y, z, h, vx, vy, vz, box: Box):
     return stationary & frozen
 
 
-def energy_update(u_old, dt, dt_m1, du, du_m1):
+def energy_update(u_old, dt, dt_m1, du, du_m1, u_lo=None):
     """2nd-order Adams-Bashforth internal-energy step (positions.hpp:54-63).
 
     The exponential fallback keeps u positive under strong cooling.
+    The reference accumulates u in DOUBLE; with ``u_lo`` given, the f32
+    accumulation is COMPENSATED (two-sum): the returned (u_new, lo_new)
+    pair carries the low bits the f32 sum would swallow (~u*eps per
+    step, the dominant 200-step drift term at Sedov's central energies).
     """
     delta_a = 0.5 * dt * dt / dt_m1
     delta_b = dt + delta_a
-    u_new = u_old + du * delta_b - du_m1 * delta_a
-    return jnp.where(
-        u_new < 0.0, u_old * jnp.exp(u_new * dt / jnp.maximum(u_old, 1e-30)), u_new
+    incr = du * delta_b - du_m1 * delta_a
+    if u_lo is None:
+        u_new = u_old + incr
+        return jnp.where(
+            u_new < 0.0,
+            u_old * jnp.exp(u_new * dt / jnp.maximum(u_old, 1e-30)), u_new,
+        )
+    y = incr + u_lo
+    s = u_old + y
+    bb = s - u_old
+    err = (u_old - (s - bb)) + (y - bb)
+    neg = s < 0.0
+    u_new = jnp.where(
+        neg, u_old * jnp.exp(s * dt / jnp.maximum(u_old, 1e-30)), s
     )
+    return u_new, jnp.where(neg, 0.0, err)
 
 
 def compute_positions(
@@ -73,10 +89,12 @@ def compute_positions(
     """Advance positions, velocities, and temperature for one step.
 
     ``state_fields`` = (x, y, z, x_m1, y_m1, z_m1, vx, vy, vz, h, temp,
-    du, du_m1); returns the same tuple advanced. Equivalent of
-    computePositions + updateTempHost (positions.hpp:115-164).
+    temp_lo, du, du_m1); returns the same tuple advanced. Equivalent of
+    computePositions + updateTempHost (positions.hpp:115-164), with the
+    compensated energy accumulation (see energy_update).
     """
-    x, y, z, x_m1, y_m1, z_m1, vx, vy, vz, h, temp, du, du_m1 = state_fields
+    (x, y, z, x_m1, y_m1, z_m1, vx, vy, vz, h, temp, temp_lo, du,
+     du_m1) = state_fields
 
     frozen = fixed_boundary_frozen(x, y, z, h, vx, vy, vz, box)
     nx, ny, nz, nvx, nvy, nvz, dx, dy, dz = position_update(
@@ -87,10 +105,16 @@ def compute_positions(
     nvx, nvy, nvz = keep(nvx, vx), keep(nvy, vy), keep(nvz, vz)
     dx, dy, dz = keep(dx, x_m1), keep(dy, y_m1), keep(dz, z_m1)
 
-    cv = const.cv
-    u_old = cv * temp
-    u_new = energy_update(u_old, dt, dt_m1, du, du_m1)
-    n_temp = jnp.where(frozen, temp, u_new / cv)
+    # compensate in TEMP units: converting the STATE through cv each
+    # step (u = cv*T then back) re-rounds the large value twice per step
+    # and defeats the carry; dividing only the small INCREMENT keeps the
+    # per-step untracked error at ulp(increment), not ulp(u)
+    n_temp, n_temp_lo = energy_update(
+        temp, dt, dt_m1, du / const.cv, du_m1 / const.cv, u_lo=temp_lo
+    )
+    n_temp = jnp.where(frozen, temp, n_temp)
+    n_temp_lo = jnp.where(frozen, temp_lo, n_temp_lo)
     n_du_m1 = jnp.where(frozen, du_m1, du)
 
-    return (nx, ny, nz, dx, dy, dz, nvx, nvy, nvz, h, n_temp, du, n_du_m1)
+    return (nx, ny, nz, dx, dy, dz, nvx, nvy, nvz, h, n_temp, n_temp_lo,
+            du, n_du_m1)
