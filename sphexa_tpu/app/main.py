@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "final snapshot before exiting if -w is enabled")
     p.add_argument("--profile", action="store_true",
                    help="save a per-iteration timing series to profile.npz")
+    p.add_argument("--devices", type=int, default=None,
+                   help="shard the run over N devices (SFC-slab domain "
+                        "decomposition; default: single device)")
     p.add_argument("--insitu", default=None,
                    help="in-situ rendering per iteration: slice | projection "
                         "(the Ascent/Catalyst adaptor role, ascent_adaptor.h)")
@@ -187,10 +190,16 @@ def main(argv=None) -> int:
     # on restart, by the case name the snapshot recorded; field-consuming
     # observables read rho/c straight from the step diagnostics
     observable = make_observable(case_name, overrides=case_overrides)
-    sim = Simulation(state, box, const, prop=args.prop,
-                     av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
-                     turb_state=turb_state, turb_cfg=turb_cfg, chem=chem_restored,
-                     keep_fields=observable.needs_fields, theta=args.theta)
+    try:
+        sim = Simulation(state, box, const, prop=args.prop,
+                         av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
+                         turb_state=turb_state, turb_cfg=turb_cfg,
+                         chem=chem_restored,
+                         keep_fields=observable.needs_fields, theta=args.theta,
+                         num_devices=args.devices)
+    except (NotImplementedError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
     log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
 
     # resuming from a snapshot continues the iteration numbering, and an

@@ -31,10 +31,11 @@ def conserved_quantities(
     ekin = 0.5 * jnp.sum(m * (state.vx**2 + state.vy**2 + state.vz**2), dtype=dt)
     # temp_lo is the energy update's compensation carry (two-sum,
     # positions.energy_update): the true internal energy includes it.
-    # Cast BEFORE adding — in f32 the sub-ulp carry would round away.
-    eint = jnp.sum(
-        const.cv * m.astype(dt)
-        * (state.temp.astype(dt) + state.temp_lo.astype(dt))
+    # Summed SEPARATELY — added per element the sub-ulp carry would
+    # round away again (exactly so in an f32 accumulation)
+    eint = (
+        jnp.sum(const.cv * state.temp * m, dtype=dt)
+        + jnp.sum(const.cv * state.temp_lo * m, dtype=dt)
     )
     etot = ekin + eint + egrav
 

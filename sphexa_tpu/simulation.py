@@ -146,6 +146,7 @@ class Simulation:
         cooling_cfg=None,
         chem=None,
         check_every: int = 1,
+        num_devices: Optional[int] = None,
     ):
         self.state = state
         self.box = box
@@ -160,6 +161,29 @@ class Simulation:
         self.ngmax = ngmax or const.ngmax
         self.theta = theta
         self.grav_bucket = grav_bucket
+        # multi-chip: shard the state over a device mesh and drive the
+        # sharded step (parallel/mesh.py) through the SAME loop —
+        # reconfiguration re-sizes the per-peer halo window exactly like
+        # the neighbor caps (the sphexa.cpp main loop never special-cases
+        # rank count either)
+        self._mesh = None
+        self._halo_margin = 1.4
+        if num_devices is not None and num_devices > 1:
+            from sphexa_tpu.parallel import make_mesh, shard_state
+
+            if prop in ("turb-ve", "std-cooling"):
+                raise NotImplementedError(
+                    f"prop={prop!r} carries extra per-step state the "
+                    "sharded stepper does not thread yet; run it "
+                    "single-device or via the library GSPMD path"
+                )
+            if state.n % num_devices:
+                raise ValueError(
+                    f"particle count {state.n} not divisible by "
+                    f"{num_devices} devices; pad the state first"
+                )
+            self._mesh = make_mesh(num_devices)
+            self.state = shard_state(state, self._mesh)
         if prop == "nbody" and const.g == 0.0:
             raise ValueError(
                 "prop='nbody' needs a gravitational constant: set SimConstants(g=...)"
@@ -242,6 +266,47 @@ class Simulation:
         )
         if self.gravity_on:
             self._configure_gravity(grav_margin)
+        if self._mesh is not None:
+            self._configure_sharded()
+
+    def _configure_sharded(self):
+        """(Re)build the sharded stepper: size the per-peer halo window
+        from the current distribution (estimate_halo_window) and bind it
+        into make_sharded_step. Called at every reconfiguration, so an
+        escape-sentinel overflow grows the window via _halo_margin."""
+        from sphexa_tpu.parallel import make_sharded_step
+        from sphexa_tpu.parallel.exchange import estimate_halo_window
+        from sphexa_tpu.propagator import _sort_by_keys
+        from sphexa_tpu.sfc.box import make_global_box
+
+        wmax = 0
+        if self._cfg.backend == "pallas" and self.prop_name != "nbody":
+            # host-side sizing like _configure_gravity: only the four
+            # arrays the window scan reads are sorted (a full
+            # _sort_by_keys would permute every field for nothing)
+            from sphexa_tpu import native
+
+            gbox = make_global_box(self.state.x, self.state.y, self.state.z,
+                                   self.box)
+            xa = np.asarray(self.state.x)
+            ya = np.asarray(self.state.y)
+            za = np.asarray(self.state.z)
+            keys = native.compute_keys(
+                xa, ya, za, np.asarray(gbox.lo), np.asarray(gbox.lengths),
+                self.curve,
+            )
+            order = native.argsort_keys(keys)
+            wmax = estimate_halo_window(
+                jnp.asarray(xa[order]), jnp.asarray(ya[order]),
+                jnp.asarray(za[order]),
+                jnp.asarray(np.asarray(self.state.h)[order]),
+                jnp.asarray(keys[order]), gbox,
+                self._cfg.nbr, P=self._mesh.size, margin=self._halo_margin,
+            )
+        self._stepper = make_sharded_step(
+            self._mesh, self._cfg, _PROPAGATORS[self.prop_name],
+            halo_window=wmax,
+        )
 
     def _configure_gravity(self, margin: float):
         """(Re)build the gravity tree structure from the current particle
@@ -307,6 +372,11 @@ class Simulation:
     def _launch(self):
         """Dispatch one jitted step on the current state (no host sync).
         Returns (new_state, new_box, diagnostics, new_turb, new_chem)."""
+        if self._mesh is not None:
+            new_state, new_box, diagnostics = self._stepper(
+                self.state, self.box, self._gtree
+            )
+            return new_state, new_box, diagnostics, None, None
         step_fn = _PROPAGATORS[self.prop_name]
         new_turb, new_chem = None, None
         if self.prop_name == "turb-ve":
@@ -355,6 +425,13 @@ class Simulation:
 
     def _reconfigure_after_overflow(self, diagnostics, grav_margin: float):
         occ = int(diagnostics["occupancy"])
+        if self._mesh is not None and occ == self._cfg.nbr.cap + 1:
+            # the cap+1 SENTINEL (not a real occupancy) is how escaped
+            # halo runs surface under sharding; grow the window margin so
+            # the rebuild converges — but never for unrelated gravity/
+            # cell-cap overflows, which would inflate comm volume for the
+            # rest of the run
+            self._halo_margin *= 1.5
         # occ == cap+1 is the window-blowout SENTINEL, not a real
         # occupancy — feeding it back as min_cap would ratchet the cap
         # (and force a fresh compile) on every blowout; a plain
