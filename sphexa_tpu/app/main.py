@@ -404,7 +404,17 @@ def main(argv=None) -> int:
     n_done = sim.iteration - it0
     if args.profile:
         profile_path = f"{args.out_dir}/profile.npz"
-        profile.save(profile_path)
+        # per-substep breakdown (the reference's per-phase Timer print,
+        # util/timer.hpp): an equivalent SPLIT execution of the final
+        # state, timed stage by stage (the fused production step has no
+        # internal walls — its fusion is the design)
+        from sphexa_tpu.util.substep_profile import substep_breakdown
+
+        sub = substep_breakdown(sim)
+        if sub:
+            log("# substeps (s, split-execution upper bound): "
+                + " ".join(f"{k}={v:.4f}" for k, v in sub.items()))
+        profile.save(profile_path, substeps=sub)
         means = profile.summary()
         log("# profile (mean s/iter): "
             + " ".join(f"{k}={v:.4f}" for k, v in means.items()

@@ -1,0 +1,124 @@
+"""In-app per-substep timing: the reference's per-phase Timer printout
+(main/src/util/timer.hpp:29-82, hook points ipropagator.hpp:80-87 —
+domain::sync / FindNeighbors / Density / IAD / MomentumEnergy ... per
+iteration).
+
+The production step is ONE fused jit, so substep walls do not exist
+inside it (that fusion is the point of the design). This module times an
+EQUIVALENT split execution of the current state — each pipeline stage as
+its own jit — at profiling granularity (once per run, not per step).
+Numbers are indicative: the fused step overlaps/fuses across these
+boundaries, so the split SUM is an upper bound on the fused step time.
+"""
+
+import time
+from typing import Dict
+
+import jax
+
+
+def _t(fn, *args, iters=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return out, (time.perf_counter() - t0) / iters
+
+
+def substep_breakdown(sim, iters: int = 3) -> Dict[str, float]:
+    """Per-stage wall times (seconds) of one force pass on the CURRENT
+    simulation state. Supports the engine ('pallas') std and ve
+    pipelines; other configurations return {} (the coarse per-iteration
+    laps in the --profile series still cover them)."""
+    from sphexa_tpu.propagator import _sort_by_keys
+    from sphexa_tpu.sfc.box import make_global_box
+    from sphexa_tpu.sph import hydro_std, hydro_ve
+    from sphexa_tpu.sph import pallas_pairs as pp
+
+    cfg = sim._cfg
+    if (cfg.backend != "pallas" or sim.prop_name not in ("std", "ve")
+            or getattr(sim, "_mesh", None) is not None):
+        # sharded runs would execute these UNsharded Pallas jits on
+        # sharded state (the production multi-chip path exists because
+        # Mosaic calls need shard_map) — skip rather than OOM/crash
+        return {}
+    const, nbr = cfg.const, cfg.nbr
+    interp = pp.pallas_interpret()
+    box = make_global_box(sim.state.x, sim.state.y, sim.state.z, sim.box)
+
+    out: Dict[str, float] = {}
+    (state, keys), out["sort"] = _t(
+        jax.jit(lambda s: _sort_by_keys(s, box, cfg.curve)[:2]), sim.state
+    )
+    x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
+    vx, vy, vz = state.vx, state.vy, state.vz
+
+    ranges, out["neighbor_prologue"] = _t(
+        jax.jit(lambda *a: pp.group_cell_ranges(*a, box, nbr)),
+        x, y, z, h, keys,
+    )
+
+    if sim.prop_name == "std":
+        (rho, _, _), out["density"] = _t(
+            jax.jit(lambda *a: pp.pallas_density(
+                *a, keys, box, const, nbr, ranges=ranges, interpret=interp)),
+            x, y, z, h, m,
+        )
+        (p, c), out["eos"] = _t(
+            jax.jit(lambda t, r: hydro_std.compute_eos_std(t, r, const)),
+            state.temp, rho,
+        )
+        (cs, _), out["iad"] = _t(
+            jax.jit(lambda *a: pp.pallas_iad(
+                *a, keys, box, const, nbr, ranges=ranges, interpret=interp)),
+            x, y, z, h, m / rho,
+        )
+        _, out["momentum_energy"] = _t(
+            jax.jit(lambda *a: pp.pallas_momentum_energy_std(
+                *a, keys, box, const, nbr, ranges=ranges, interpret=interp)),
+            x, y, z, vx, vy, vz, h, m, rho, p, c, *cs,
+        )
+        return out
+
+    (xm, nc, _), out["xmass"] = _t(
+        jax.jit(lambda *a: pp.pallas_xmass(
+            *a, keys, box, const, nbr, ranges=ranges, interpret=interp)),
+        x, y, z, h, m,
+    )
+    ((kx, gradh), _), out["ve_def_gradh"] = _t(
+        jax.jit(lambda *a: pp.pallas_ve_def_gradh(
+            *a, keys, box, const, nbr, ranges=ranges, interpret=interp)),
+        x, y, z, h, m, xm,
+    )
+    (prho, c, rho, p), out["eos"] = _t(
+        jax.jit(lambda *a: hydro_ve.compute_eos_ve(*a, const)),
+        state.temp, m, kx, xm, gradh,
+    )
+    (cs, _), out["iad"] = _t(
+        jax.jit(lambda *a: pp.pallas_iad(
+            *a, keys, box, const, nbr, ranges=ranges, interpret=interp)),
+        x, y, z, h, xm / kx,
+    )
+    (dvout, _), out["divv_curlv"] = _t(
+        jax.jit(lambda *a: pp.pallas_iad_divv_curlv(
+            *a, keys, box, const, nbr, ranges=ranges,
+            with_gradv=cfg.av_clean, interpret=interp)),
+        x, y, z, vx, vy, vz, h, kx, xm, *cs,
+    )
+    divv = dvout[0]
+    (alpha, _), out["av_switches"] = _t(
+        jax.jit(lambda *a: pp.pallas_av_switches(
+            *a, keys, box, state.min_dt, const, nbr, ranges=ranges,
+            interpret=interp)),
+        x, y, z, vx, vy, vz, h, c, kx, xm, divv, state.alpha, *cs,
+    )
+    gradv = tuple(dvout[2:]) if cfg.av_clean else None
+    _, out["momentum_energy"] = _t(
+        jax.jit(lambda *a: pp.pallas_momentum_energy_ve(
+            *a, keys, box, const, nbr, nc=nc, gradv=gradv, ranges=ranges,
+            interpret=interp)),
+        x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha, *cs,
+    )
+    return out

@@ -48,13 +48,17 @@ class ProfileRecorder:
     def record(self, iteration: int, laps: Dict[str, float], **metrics):
         self.rows.append({"iteration": float(iteration), **laps, **metrics})
 
-    def save(self, path: str):
-        if not self.rows:
+    def save(self, path: str, substeps=None):
+        """Write the per-iteration series (+ optional one-shot substep
+        breakdown, stored as substep_<name> scalars)."""
+        if not self.rows and not substeps:
             return
         keys = sorted({k for row in self.rows for k in row})
         arrays = {
             k: np.array([row.get(k, np.nan) for row in self.rows]) for k in keys
         }
+        for k, v in (substeps or {}).items():
+            arrays[f"substep_{k}"] = np.float64(v)
         np.savez(path, **arrays)
 
     def summary(self) -> Dict[str, float]:
