@@ -99,16 +99,9 @@ def main() -> int:
     except Exception as e:
         print(f"bench: VE+gravity line failed: {e}", file=sys.stderr)
 
-    notes = (
-        "std step breakdown @100^3 (v5e): sort ~35ms, prologue ~35ms, pair "
-        "kernels ~340ms (near VPU peak ~2.7 TF/s f32), tail ~20ms; engine "
-        "streams ~3500 candidate lanes/target vs ~110 true neighbors "
-        "(128-lane chunk granularity; chunk-cull skips 29% of chunks); "
-        "remaining gap to 1e7 = lane occupancy x v5e-VPU:A100 FLOP ratio "
-        "(MXU j-reduction offload measured slower: per-chunk relayout). "
-        "Gravity MAC classification is dense (blocks x nodes) with "
-        "mac_work_ratio diagnostic; sparse frontier is the scaling TODO."
-    )
+    # measured breakdowns/commentary live in docs/NEXT.md, labeled with the
+    # hardware + commit they were taken on — repeating them here would
+    # assert stale numbers on every future run
     print(
         json.dumps(
             {
@@ -117,7 +110,6 @@ def main() -> int:
                 "unit": "particles/s",
                 "vs_baseline": round(std_ups / BASELINE_UPDATES_PER_SEC, 4),
                 "extra": extra,
-                "notes": notes,
             }
         )
     )

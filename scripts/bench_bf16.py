@@ -1,0 +1,136 @@
+"""Microbench: Mosaic vector bf16 vs f32 pair-math throughput on (G, 128)
+tiles — decides whether the engine's pair kernels should compute in bf16
+(NEXT.md lever 2). Measures a momentum-like per-chunk body (W poly, AV,
+IAD projections) iterated over a VMEM-resident candidate ring, isolating
+VPU arithmetic from DMA.
+
+Usage: python scripts/bench_bf16.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+G = 128
+CHUNKS = 32  # VMEM-resident candidate chunks per group
+NG = 512     # groups (grid size)
+ITERS = 20
+
+
+def make_kernel(dtype):
+    cast = lambda a: a.astype(dtype)
+
+    def kernel(i_ref, j_ref, o_ref, acc1, acc2, acc3, acc4):
+        xi = i_ref[0, 0][:, None]
+        yi = i_ref[0, 1][:, None]
+        zi = i_ref[0, 2][:, None]
+        hi = i_ref[0, 3][:, None]
+        c1 = cast(i_ref[0, 4][:, None])
+        c2 = cast(i_ref[0, 5][:, None])
+        c3 = cast(i_ref[0, 6][:, None])
+        inv_h2 = cast(1.0 / (hi * hi))
+        h4 = 4.0 * hi * hi
+        acc1[...] = jnp.zeros((G, 128), jnp.float32)
+        acc2[...] = jnp.zeros((G, 128), jnp.float32)
+        acc3[...] = jnp.zeros((G, 128), jnp.float32)
+        acc4[...] = jnp.zeros((G, 128), jnp.float32)
+
+        def body(c, carry):
+            chunk = j_ref[c]  # (8, 128) f32
+            jx = chunk[0][None, :]
+            jy = chunk[1][None, :]
+            jz = chunk[2][None, :]
+            mj = cast(chunk[3][None, :])
+            vj = cast(chunk[4][None, :])
+            # geometry stays f32 (neighbor dx needs the mantissa)
+            rx = xi - jx
+            ry = yi - jy
+            rz = zi - jz
+            d2 = rx * rx + ry * ry + rz * rz
+            mask = d2 < h4
+            # ---- castable pair math (the bf16 candidate zone) ----
+            u = cast(d2) * inv_h2
+            rxc, ryc, rzc = cast(rx), cast(ry), cast(rz)
+            w = u
+            for _ in range(7):  # 14 FMA poly eval stand-in
+                w = w * u + dtype(0.5)
+                w = w * u + dtype(0.25)
+            t1 = c1 * rxc + c2 * ryc + c3 * rzc
+            t2 = c2 * rxc + c3 * ryc + c1 * rzc
+            t3 = c3 * rxc + c1 * ryc + c2 * rzc
+            rv = rxc * vj + ryc * vj + rzc * vj
+            visc = jnp.where(rv < 0, -rv * w, dtype(0))
+            a = mj * w + visc
+            e1 = (a * t1 + visc * t2).astype(jnp.float32)
+            e2 = (a * t2 + visc * t3).astype(jnp.float32)
+            e3 = (a * t3 + visc * t1).astype(jnp.float32)
+            e4 = (rv * a).astype(jnp.float32)
+            zero = jnp.float32(0)
+            acc1[...] = acc1[...] + jnp.where(mask, e1, zero)
+            acc2[...] = acc2[...] + jnp.where(mask, e2, zero)
+            acc3[...] = acc3[...] + jnp.where(mask, e3, zero)
+            acc4[...] = acc4[...] + jnp.where(mask, e4, zero)
+            return carry
+
+        jax.lax.fori_loop(0, CHUNKS, body, 0)
+        o_ref[0, 0, :] = (
+            jnp.sum(acc1[...], axis=1) + jnp.sum(acc2[...], axis=1)
+            + jnp.sum(acc3[...], axis=1) + jnp.sum(acc4[...], axis=1)
+        )
+
+    return kernel
+
+
+def run(dtype, label):
+    kern = make_kernel(dtype)
+    call = pl.pallas_call(
+        kern,
+        grid=(NG,),
+        in_specs=[
+            pl.BlockSpec((1, 8, G), lambda g: (g, 0, 0)),
+            pl.BlockSpec((CHUNKS, 8, 128), lambda g: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, G), lambda g: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((NG, 8, G), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((G, 128), jnp.float32) for _ in range(4)],
+    )
+    # in_specs deliver (1, 8, G) blocks; kernel indexes [0] -> (8, G)? No:
+    # block shape (1, 8, G) gives ref shape (1, 8, G); squeeze via [0].
+    def wrap(i, j):
+        return call(i, j)
+
+    i = jax.random.normal(jax.random.PRNGKey(0), (NG, 8, G), jnp.float32)
+    i = i.at[:, 3].set(jnp.abs(i[:, 3]) + 0.5)
+    j = jax.random.normal(jax.random.PRNGKey(1), (CHUNKS, 8, 128), jnp.float32)
+    out = wrap(i, j)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = wrap(i, j)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / ITERS
+    lanes = NG * G * CHUNKS * 128
+    # ~60 castable flops + ~20 f32 flops per lane in this body
+    print(f"{label:8s} {dt * 1e3:8.3f} ms   {lanes / dt / 1e12:.3f} Tlane/s")
+    return dt
+
+
+def main():
+    print(f"backend={jax.default_backend()}  NG={NG} CHUNKS={CHUNKS}")
+    f32 = run(jnp.float32, "f32")
+    try:
+        bf16 = run(jnp.bfloat16, "bf16")
+        print(f"speedup bf16/f32: {f32 / bf16:.2f}x")
+    except Exception as e:
+        print(f"bf16 FAILED: {type(e).__name__}: {str(e)[:500]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,7 @@ from sphexa_tpu.sph import hydro_std
 from sphexa_tpu.sph import pallas_pairs as pp
 
 SIDE = int(os.environ.get("PROF_SIDE", "100"))
-ITERS = 3
+ITERS = 5
 
 
 def time_config(state, box, const, cell_target, run_cap, gap, group):
@@ -64,6 +64,7 @@ def time_config(state, box, const, cell_target, run_cap, gap, group):
     for _ in range(ITERS):
         out = pipeline(*args)
     jax.block_until_ready(out)
+    _ = float(jnp.sum(out[3]))  # device_get: force real completion (axon)
     dt = (time.perf_counter() - t0) / ITERS
     nrun = float(jnp.mean(out[4].astype(jnp.float32)))
     print(f"  ct={cell_target:4d} rc={run_cap:4d} gap={gap:3d} g={group:3d}"
@@ -81,8 +82,8 @@ def main():
     state, _, _ = _sort_by_keys(state, box, "hilbert")
 
     for group in (64, 128, 256):
-        for cell_target in (128, 64, 32, 16):
-            for run_cap, gap in ((0, 0), (512, 0), (768, 96), (1024, 256)):
+        for cell_target in (128, 256):
+            for run_cap, gap in ((1536, 384), (2048, 512), (1024, 256)):
                 try:
                     time_config(state, box, const, cell_target, run_cap, gap, group)
                 except Exception as e:  # noqa

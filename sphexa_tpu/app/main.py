@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--devices", type=int, default=None,
                    help="shard the run over N devices (SFC-slab domain "
                         "decomposition; default: single device)")
+    p.add_argument("--cpu-mesh", action="store_true", dest="cpu_mesh",
+                   help="force an N-virtual-device CPU mesh for --devices "
+                        "runs on hosts with fewer real chips (validation "
+                        "mode; same mechanism as the multi-chip dry run)")
     p.add_argument("--insitu", default=None,
                    help="in-situ rendering per iteration: slice | projection "
                         "(the Ascent/Catalyst adaptor role, ascent_adaptor.h)")
@@ -69,6 +73,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.cpu_mesh:
+        # explicit N-virtual-device CPU mesh (the mechanism the multi-chip
+        # dry run and tests use) for driving --devices N on hosts with
+        # fewer real chips; must run before jax's lazy backend init
+        from sphexa_tpu.util.cpu_mesh import force_cpu_mesh
+
+        try:
+            force_cpu_mesh(args.devices or 8)
+        except RuntimeError as e:
+            print(f"--cpu-mesh: {e}", file=sys.stderr)
+            return 2
 
     from sphexa_tpu.init import make_initializer
     from sphexa_tpu.observables import (
@@ -190,6 +206,20 @@ def main(argv=None) -> int:
     # on restart, by the case name the snapshot recorded; field-consuming
     # observables read rho/c straight from the step diagnostics
     observable = make_observable(case_name, overrides=case_overrides)
+    if args.devices and args.devices > 1 and state.n % args.devices:
+        # slab sharding needs a mesh-divisible count; trim the trailing
+        # SFC rows (cases with non-cubic counts, e.g. sphere cuts, already
+        # truncate at an arbitrary boundary — this moves it by < P rows)
+        import jax as _jax
+
+        keep = (state.n // args.devices) * args.devices
+        print(f"# trimming {state.n - keep} trailing particles for an "
+              f"even {args.devices}-way slab decomposition", file=sys.stderr)
+        state = _jax.tree.map(
+            lambda a: a[:keep] if getattr(a, "ndim", 0) >= 1
+            and a.shape[0] == state.n else a,
+            state,
+        )
     try:
         sim = Simulation(state, box, const, prop=args.prop,
                          av_clean=args.avclean and args.prop in ("ve", "turb-ve"),

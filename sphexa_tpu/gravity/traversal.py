@@ -73,6 +73,26 @@ class GravityConfig:
     use_pallas: bool = False
 
 
+@functools.partial(jax.jit, static_argnames=("blk",))
+def _block_bboxes(x, y, z, blk: int):
+    """Per-target-block bounding boxes, (nb, 3) min / (nb, 3) max — the
+    only per-particle quantity the cap estimator needs (tail block padded
+    with the last row, which only shrinks nothing)."""
+    n = x.shape[0]
+    nb = -(-n // blk)
+    pad = nb * blk - n
+
+    def blocked(a):
+        if pad:
+            a = jnp.concatenate([a, jnp.broadcast_to(a[-1:], (pad,))])
+        return a.reshape(nb, blk)
+
+    xs, ys, zs = blocked(x), blocked(y), blocked(z)
+    bmin = jnp.stack([xs.min(1), ys.min(1), zs.min(1)], axis=1)
+    bmax = jnp.stack([xs.max(1), ys.max(1), zs.max(1)], axis=1)
+    return bmin, bmax
+
+
 def estimate_gravity_caps(
     x, y, z, m, sorted_keys, box: Box,
     tree: GravityTree, meta: GravityTreeMeta, cfg: GravityConfig,
@@ -89,26 +109,33 @@ def estimate_gravity_caps(
     node_mass, node_com, node_q, edges = compute_multipoles(
         x, y, z, m, sorted_keys, tree, meta
     )
-    nm = np.asarray(node_mass)
-    com = np.asarray(node_com)
-    edges = np.asarray(edges)
+    # everything fetched is O(tree) or O(N/target_block) — never the
+    # particle arrays themselves (the O(N/P) reconfiguration contract,
+    # VERDICT r3 #3); per-block bboxes come from one jitted reduction
+    from sphexa_tpu.parallel.sizing import fetch
+
+    nm = np.asarray(fetch(node_mass))
+    com = np.asarray(fetch(node_com))
+    edges = np.asarray(fetch(edges))
     valid = nm > 0.0
-    parent = np.asarray(tree.parent)
-    is_leaf = np.asarray(tree.is_leaf)
+    parent = np.asarray(fetch(tree.parent))
+    is_leaf = np.asarray(fetch(tree.is_leaf))
     counts = np.diff(edges)
 
-    lengths = np.asarray(box.lengths)
-    lo = np.asarray([box.lo[0], box.lo[1], box.lo[2]], dtype=np.float64)
-    geo_center = lo[None, :] + np.asarray(tree.center_frac) * lengths[None, :]
-    geo_size = np.asarray(tree.halfsize_frac)[:, None] * lengths[None, :]
+    lengths = np.asarray(fetch(box.lengths))
+    lo = np.asarray(fetch(
+        jnp.stack([box.lo[0], box.lo[1], box.lo[2]])
+    ), dtype=np.float64)
+    geo_center = lo[None, :] + np.asarray(fetch(tree.center_frac)) * lengths[None, :]
+    geo_size = np.asarray(fetch(tree.halfsize_frac))[:, None] * lengths[None, :]
     l_node = 2.0 * geo_size.max(axis=1)
     s_off = np.linalg.norm(com - geo_center, axis=1)
     mac2 = (l_node / cfg.theta + s_off) ** 2
 
-    xa, ya, za = np.asarray(x), np.asarray(y), np.asarray(z)
-    n = len(xa)
+    n = x.shape[0]
     blk = cfg.target_block
     nb = -(-n // blk)
+    bmin, bmax = (np.asarray(a) for a in fetch(_block_bboxes(x, y, z, blk)))
     rng = np.random.default_rng(0)
     blocks = (
         np.arange(nb)
@@ -116,10 +143,9 @@ def estimate_gravity_caps(
         else np.unique(np.concatenate([[0, nb - 1], rng.integers(0, nb, sample_blocks)]))
     )
 
-    def classify(lo_i, hi_i):
-        sl = slice(lo_i, hi_i)
-        pmin = np.array([xa[sl].min(), ya[sl].min(), za[sl].min()])
-        pmax = np.array([xa[sl].max(), ya[sl].max(), za[sl].max()])
+    def classify(b0, b1):
+        pmin = bmin[b0:b1].min(axis=0)
+        pmax = bmax[b0:b1].max(axis=0)
         bc, bs = (pmax + pmin) / 2, (pmax - pmin) / 2
         d = np.maximum(np.abs(bc[None, :] - com) - bs[None, :], 0.0)
         accept = valid & ~((d * d).sum(axis=1) < mac2)
@@ -130,7 +156,7 @@ def estimate_gravity_caps(
 
     m2p_max, p2p_max = 1, 1
     for b in blocks:
-        accept, anc = classify(b * blk, min((b + 1) * blk, n))
+        accept, anc = classify(b, b + 1)
         m2p_max = max(m2p_max, int((accept & ~anc).sum()))
         p2p_max = max(p2p_max, int((is_leaf & valid & ~accept & ~anc).sum()))
 
@@ -148,7 +174,8 @@ def estimate_gravity_caps(
             ))
         )
         for b in supers:
-            _, anc = classify(b * sblk, min((b + 1) * sblk, n))
+            _, anc = classify(b * cfg.super_factor,
+                              min((b + 1) * cfg.super_factor, nb))
             c_cap_max = max(c_cap_max, int((~anc).sum()))
 
     def pad(v):

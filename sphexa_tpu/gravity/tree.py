@@ -68,6 +68,18 @@ def build_gravity_tree(
     """
     keys = np.asarray(sorted_keys, dtype=np.uint64)
     leaf_tree, _counts = compute_octree(keys, bucket_size)
+    return linkage_from_leaves(leaf_tree, curve)
+
+
+def linkage_from_leaves(
+    leaf_tree, curve: str = "hilbert"
+) -> Tuple[GravityTree, GravityTreeMeta]:
+    """Internal linkage + geometry from a prebuilt cornerstone leaf array
+    (updateInternalTree, octree.hpp role). Callers that never materialize
+    the global key array on the host — the distributed histogram-pyramid
+    build (parallel/sizing.py, the update_mpi.hpp transposition) — enter
+    here with their leaf boundaries."""
+    leaf_tree = np.asarray(leaf_tree, dtype=np.uint64)
     leaf_levels = node_levels(leaf_tree)
     leaf_starts = leaf_tree[:-1]
     num_leaves = len(leaf_starts)

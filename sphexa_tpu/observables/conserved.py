@@ -8,6 +8,7 @@ tree reduction in float32; under a sharded step the jnp.sum lowers to a
 psum-style collective.
 """
 
+import functools
 from typing import Dict
 
 import jax
@@ -23,6 +24,13 @@ def _acc_dtype():
     return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
 
+# jitted into ONE program: eagerly, each independent sum over a SHARDED
+# state is its own collective program, and the CPU backend executes
+# cached independent programs concurrently on one thread pool — their
+# all-reduce rendezvous interleave and deadlock (observed on the
+# 8-virtual-device mesh). One program also matches the reference's single
+# reduction sweep (conserved_quantities.hpp:40-179).
+@functools.partial(jax.jit, static_argnames=("const",))
 def conserved_quantities(
     state: ParticleState, const: SimConstants, egrav=0.0
 ) -> Dict[str, jnp.ndarray]:

@@ -106,6 +106,11 @@ def _split_runs(starts, lens, shifts3, S: int, extra: int = 8):
     boundary and the remainder pieces are appended as fresh runs;
     everything is re-compacted front-first. Returns (starts, lens,
     shifts3, nruns, overflow) with ``extra`` more slots per group.
+
+    ``extra`` must scale with the mesh: one group can need up to P-1
+    crossing remainders (callers pass max(8, P-1) — growing the halo
+    window can never fix slot exhaustion, so under-sizing here would
+    make the escape-sentinel retry loop diverge).
     """
     ng, w3 = starts.shape
     shx, shy, shz = shifts3
@@ -237,6 +242,7 @@ def localize_ranges(
     starts, lens, sh3, nruns, split_ovf = _split_runs(
         ranges.starts, ranges.lens,
         (ranges.shift_x, ranges.shift_y, ranges.shift_z), S,
+        extra=max(8, P - 1),
     )
     mine, bounds_all = window_bounds(starts, lens, S, P, k, axis)
     lo_eff = _effective_lo(bounds_all, S, Wmax, P)[k]  # (P_src,)

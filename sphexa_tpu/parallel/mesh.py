@@ -50,8 +50,22 @@ def shard_state(state, mesh: Mesh):
     return jax.tree.map(place, state)
 
 
+def _place_aux_leaf(leaf, n: int, place, pspec, rspec):
+    """SINGLE placement rule for aux (turbulence/chemistry) pytree leaves:
+    per-particle arrays (first dim == n) ride the slab sharding, other
+    arrays replicate, scalars pass through. Shared by the input commit
+    (device_put) and the output constraint so they can never drift apart
+    into two executable variants."""
+    nd = getattr(leaf, "ndim", 0)
+    if nd >= 1 and leaf.shape[0] == n:
+        return place(leaf, pspec)
+    if nd >= 1:
+        return place(leaf, rspec)
+    return leaf
+
+
 def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
-                      halo_window: int = 0):
+                      halo_window: int = 0, aux_cfg=None):
     """Jit the full step with particle arrays sharded over the mesh.
 
     GSPMD partitions the entire program: the SFC sort's key exchange is the
@@ -64,21 +78,33 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
     tree as a third argument: ``stepper(state, box, gtree)``; the (small)
     tree arrays stay replicated across the mesh, matching the reference's
     replicated global octree (assignment.hpp:51-53).
+
+    turb-ve / std-cooling carry extra per-step state through the stepper
+    (the reference runs every propagator under the full MPI domain,
+    turb_ve.hpp:53 / std_hydro_grackle.hpp:56): pass their static config
+    as ``aux_cfg`` and call ``stepper(state, box, gtree, aux)`` with the
+    TurbulenceState / ChemistryData pytree; the stepper returns
+    ``(state, box, diag, new_aux)``. Turbulence phases are replicated
+    (they are global mode tables); chemistry arrays are per-particle and
+    ride the slab sharding + the in-step SFC sort.
     """
+    from sphexa_tpu.propagator import (
+        step_hydro_std_cooling,
+        step_hydro_ve,
+        step_turb_ve,
+    )
+
+    aux_props = {step_turb_ve, step_hydro_std_cooling}
     # GSPMD has no auto-partitioning rule for Mosaic (pallas) custom calls,
     # so the pallas pair stage runs under an explicit shard_map: each
     # device executes the fused engine on its SFC slab with windowed
     # all_to_all halos (propagator._std_forces_sharded /
-    # _ve_forces_sharded). The nbody step has no pair stage — it falls
-    # back to the GSPMD-partitioned XLA gravity path.
+    # _ve_forces_sharded). turb-ve and std-cooling reuse those same force
+    # stages; their extra physics (stirring accel, cooling source) is
+    # plain XLA on sharded arrays, which GSPMD partitions. The nbody step
+    # has no pair stage — it falls back to the GSPMD XLA gravity path.
     if cfg.backend == "pallas":
-        from sphexa_tpu.propagator import step_hydro_ve
-
-        # turb-ve / std-cooling share these force stages but carry extra
-        # per-step state (turbulence phases, chemistry) that this stepper
-        # signature does not thread through yet — they stay on the GSPMD
-        # XLA path, as does the pair-stage-free nbody step
-        if step_fn in (step_hydro_std, step_hydro_ve):
+        if step_fn in ({step_hydro_std, step_hydro_ve} | aux_props):
             cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p",
                                       halo_window=halo_window)
         else:
@@ -99,8 +125,14 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
 
     rspec = NamedSharding(mesh, P())
 
-    def inner(s, b, gtree=None):
-        new_state, new_box, diag = step_fn(s, b, cfg, gtree)
+    def inner(s, b, gtree=None, aux=None):
+        if step_fn in aux_props:
+            new_state, new_box, diag, new_aux = step_fn(
+                s, b, cfg, gtree, aux, aux_cfg
+            )
+        else:
+            new_state, new_box, diag = step_fn(s, b, cfg, gtree)
+            new_aux = None
         # keep the particle arrays sharded on the way out so the next step
         # starts from slab-owned arrays (no silent replication creep)...
         constrain = lambda l: (
@@ -113,18 +145,34 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
             jax.lax.with_sharding_constraint(l, rspec)
             if getattr(l, "ndim", 0) >= 1 else l
         )
+        # aux leaves: per-particle arrays (chemistry) stay slab-sharded,
+        # global tables (turbulence modes/phases) stay replicated
+        aux_place = lambda l: _place_aux_leaf(
+            l, s.n, jax.lax.with_sharding_constraint, pspec, rspec
+        )
         return (jax.tree.map(constrain, new_state),
-                jax.tree.map(rep, new_box), diag)
+                jax.tree.map(rep, new_box), diag,
+                jax.tree.map(aux_place, new_aux))
 
     # inputs are placed by shard_state; GSPMD propagates those shardings
     # through the whole program, one compiled executable reused every step
     jitted = jax.jit(inner)
 
-    def stepper(s, b, gtree=None):
-        # commit the box replicated BEFORE the first call: an uncommitted
-        # box on step 0 compiles a second executable variant, and on CPU
-        # meshes two variants' collective channels can collide mid-run
+    def stepper(s, b, gtree=None, aux=None):
+        # commit the box (and aux, same placement rule as aux_place)
+        # replicated/sharded BEFORE the first call: an uncommitted input
+        # on step 0 compiles a second executable variant vs the committed
+        # step-1 outputs, and on CPU meshes two variants' collective
+        # channels can collide mid-run
         b = jax.device_put(b, rspec)
-        return jitted(s, b, gtree)
+        if aux is not None:
+            aux = jax.tree.map(
+                lambda l: _place_aux_leaf(
+                    l, s.n, jax.device_put, pspec, rspec
+                ),
+                aux,
+            )
+        out = jitted(s, b, gtree, aux)
+        return out if step_fn in aux_props else out[:3]
 
     return stepper

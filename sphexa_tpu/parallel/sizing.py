@@ -1,0 +1,286 @@
+"""Device-side (re)configuration sizing: O(N/P)-transfer replacements for
+the host gathers in make_propagator_config / Simulation._configure*.
+
+The reference never materializes the global problem on one rank: octree
+counts are allreduce-incremental (cstone/tree/update_mpi.hpp:26-106) and
+assignment is rank-local (cstone/domain/assignment.hpp:84-122). The
+transposition here: every sizing quantity is computed by jitted reductions
+over the (possibly sharded) device arrays — GSPMD partitions them over the
+mesh — and only SCALARS or O(#cells) histograms ever reach the host.
+
+Three groups of helpers:
+
+- ``sizing_stats``: max cell occupancy, per-dim group extents, h_max — the
+  inputs of make_propagator_config's level/cap/window choice.
+- ``device_halo_window``: the per-(dest, src) shard row-window maximum that
+  sizes the windowed all_to_all exchange (parallel/exchange.py), computed
+  with scatter-min/max instead of the host loop in estimate_halo_window.
+- ``key_histogram``/``drill_histogram`` + ``leaf_array_from_device_keys``:
+  the distributed-tree-build analog — a base-level key histogram plus
+  targeted drill-downs of overfull cells replaces shipping the full key
+  array to the host (update_mpi.hpp's node-count allreduce, transposed).
+"""
+
+import functools
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
+
+INF32 = jnp.int32(2**30)
+
+# device->host bytes moved by the sizing path since the last reset — the
+# transfer-size counter that PROVES reconfiguration is O(N/P): every fetch
+# in the device-sizing path goes through fetch(), and tests run the whole
+# configure under jax.transfer_guard_device_to_host("disallow") so a stray
+# implicit np.asarray(full_array) fails loudly instead of hiding.
+TRANSFER_BYTES = 0
+
+
+def reset_transfer_bytes() -> None:
+    global TRANSFER_BYTES
+    TRANSFER_BYTES = 0
+
+
+def fetch(x):
+    """Explicit, metered device->host transfer (allowed under the
+    device-to-host transfer guard; implicit transfers are not)."""
+    global TRANSFER_BYTES
+    out = jax.device_get(x)
+    TRANSFER_BYTES += sum(
+        a.nbytes for a in jax.tree.leaves(out) if hasattr(a, "nbytes")
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# neighbor-config sizing
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("level", "group", "curve"))
+def sizing_stats(x, y, z, h, box, level: int, group: int,
+                 curve: str = "hilbert"):
+    """(occ_max, ext (3,), h_max): everything make_propagator_config needs
+    beyond n — one jitted pass, five scalars to the host."""
+    from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+    keys = compute_sfc_keys(x, y, z, box, curve=curve)
+    order = jnp.argsort(keys)
+    skeys = keys[order]
+    shift = KEY_DTYPE(3 * (KEY_BITS - level))
+    ncell3 = (1 << level) ** 3
+    cid = (skeys >> shift).astype(jnp.int32)
+    occ = jnp.max(jnp.zeros(ncell3, jnp.int32).at[cid].add(1))
+
+    n = x.shape[0]
+    ng = -(-n // group)
+    pad = ng * group - n
+
+    def ext_of(a):
+        a = a[order]
+        if pad:
+            a = jnp.concatenate([a, jnp.broadcast_to(a[-1:], (pad,))])
+        g = a.reshape(ng, group)
+        return jnp.max(g.max(axis=1) - g.min(axis=1))
+
+    ext = jnp.stack([ext_of(x), ext_of(y), ext_of(z)])
+    return occ, ext, jnp.max(h)
+
+
+# ---------------------------------------------------------------------------
+# halo-window sizing
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("nbr", "P"))
+def _halo_window_spans(x, y, z, h, keys, box, nbr, P: int):
+    """Max over (dest, src != dest) pairs of the source-row span dest's
+    candidate runs need — the device analog of estimate_halo_window's
+    host loop, via scatter-min/max into a (P, P) bounds matrix."""
+    from sphexa_tpu.sph.pallas_pairs import group_cell_ranges
+
+    order = jnp.argsort(keys)
+    xs, ys, zs, hs = x[order], y[order], z[order], h[order]
+    skeys = keys[order]
+    ranges = group_cell_ranges(xs, ys, zs, hs, skeys, box, nbr)
+    starts, lens = ranges.starts, ranges.lens  # (NG, W3)
+    ng, w3 = starts.shape
+    n = x.shape[0]
+    S = -(-n // P)
+
+    # a group's rows can straddle two dest slabs: charge its runs to both
+    g0 = (jnp.arange(ng, dtype=jnp.int32) * nbr.group) // S
+    g1 = jnp.minimum(
+        ((jnp.arange(ng, dtype=jnp.int32) + 1) * nbr.group - 1) // S, P - 1
+    )
+
+    active = lens > 0
+    ends = starts + lens
+    # a run crossing a slab boundary contributes a clipped piece to both
+    # sources (the caller clamps run_cap <= S, so a run touches at most
+    # two slabs and the two pieces below cover it exactly)
+    src0 = jnp.clip(starts // S, 0, P - 1)
+    src1 = jnp.clip(jnp.where(active, ends - 1, starts) // S, 0, P - 1)
+
+    lo_m = jnp.full((P, P), INF32, jnp.int32)
+    hi_m = jnp.zeros((P, P), jnp.int32)
+
+    def add_piece(lo_m, hi_m, dest, src, lo, hi, valid):
+        d = jnp.broadcast_to(dest[:, None], (ng, w3))
+        lo = jnp.where(valid, lo, INF32)
+        hi = jnp.where(valid, hi, 0)
+        lo_m = lo_m.at[d, src].min(lo)
+        hi_m = hi_m.at[d, src].max(hi)
+        return lo_m, hi_m
+
+    for dest in (g0, g1):
+        # piece inside the run's first source slab
+        p0_hi = jnp.minimum(ends, (src0 + 1) * S)
+        lo_m, hi_m = add_piece(lo_m, hi_m, dest, src0, starts, p0_hi, active)
+        # remainder in the next slab (zero-width unless crossing)
+        cross = active & (src1 > src0)
+        lo_m, hi_m = add_piece(
+            lo_m, hi_m, dest, src1, src1 * S, ends, cross
+        )
+
+    off_diag = ~jnp.eye(P, dtype=bool)
+    span = jnp.where(off_diag & (hi_m > 0), hi_m - jnp.minimum(lo_m, hi_m), 0)
+    return jnp.max(span)
+
+
+def device_halo_window(x, y, z, h, keys, box, nbr, P: int,
+                       margin: float = 1.4, quantum: int = 1024) -> int:
+    """estimate_halo_window with device-side discovery: one scalar comes
+    to the host. Same margin/quantum padding contract."""
+    import dataclasses
+
+    n = x.shape[0]
+    S = -(-n // P)
+    # the sharded force stage clamps run_cap to the slab size (a run must
+    # come from one source shard, propagator._std_forces_sharded), so
+    # measure with the SAME clamp — it also guarantees a run spans at most
+    # two slabs, which the two-piece scatter below relies on
+    if nbr.run_cap > S:
+        nbr = dataclasses.replace(nbr, run_cap=S)
+    wmax = max(int(fetch(_halo_window_spans(x, y, z, h, keys, box, nbr, P))), 1)
+    padded = int(-(-int(wmax * margin) // quantum) * quantum)
+    return min(padded, S)
+
+
+# ---------------------------------------------------------------------------
+# distributed gravity-tree build (histogram pyramid + drill-down)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("level",))
+def key_histogram(keys, level: int):
+    """Global cell-occupancy histogram at ``level`` over the (sharded) key
+    array: the allreduce'd node-count vector of update_mpi.hpp:26-106.
+    O(8^level) ints to the host, independent of N."""
+    shift = KEY_DTYPE(3 * (KEY_BITS - level))
+    cid = (keys >> shift).astype(jnp.int32)
+    return jnp.zeros((1 << (3 * level),), jnp.int32).at[cid].add(1)
+
+
+@functools.partial(jax.jit, static_argnames=("level", "sub", "k_cap"))
+def drill_histogram(keys, cell_ids_sorted, level: int, sub: int, k_cap: int):
+    """Counts of the 8^sub sub-cells of ``k_cap`` selected cells at
+    ``level`` — the targeted refinement round for cells still above the
+    bucket size (keys outside the selected cells fall in a discard bin).
+    cell_ids_sorted: (k_cap,) int32 sorted cell indices, padded with 2^30.
+    Returns (k_cap, 8^sub) int32."""
+    nsub = 1 << (3 * sub)
+    shift_hi = KEY_DTYPE(3 * (KEY_BITS - level))
+    cid = (keys >> shift_hi).astype(jnp.int32)
+    pos = jnp.searchsorted(cell_ids_sorted, cid).astype(jnp.int32)
+    pos_c = jnp.clip(pos, 0, k_cap - 1)
+    hit = cell_ids_sorted[pos_c] == cid
+    shift_lo = KEY_DTYPE(3 * (KEY_BITS - level - sub))
+    subid = ((keys >> shift_lo) & KEY_DTYPE(nsub - 1)).astype(jnp.int32)
+    b = jnp.where(hit, pos_c * nsub + subid, k_cap * nsub)
+    hist = jnp.zeros((k_cap * nsub + 1,), jnp.int32).at[b].add(1)
+    return hist[: k_cap * nsub].reshape(k_cap, nsub)
+
+
+def leaf_array_from_device_keys(
+    keys_dev, bucket_size: int, base_level: int = 5, sub: int = 2,
+    k_cap: int = 4096,
+) -> np.ndarray:
+    """Cornerstone leaf array (sorted start keys + KEY_MAX sentinel) built
+    WITHOUT shipping the key array to the host.
+
+    Top-down equivalent of compute_octree (csarray.hpp:456 invariant): a
+    node splits while its count exceeds ``bucket_size`` (never creating a
+    mergeable sibling set, so the result equals the converged rebalance,
+    capped at the key resolution KEY_BITS). Counts come from one
+    base-level histogram plus drill rounds over the overfull frontier.
+    """
+    base_level = min(base_level, KEY_BITS)
+    hist = np.asarray(fetch(key_histogram(keys_dev, base_level)))
+    # aggregate the pyramid upward (host, O(8^base) ints)
+    pyramid = {base_level: hist.astype(np.int64)}
+    for lvl in range(base_level - 1, -1, -1):
+        pyramid[lvl] = pyramid[lvl + 1].reshape(-1, 8).sum(axis=1)
+
+    leaves: list = []  # (cell_index, level)
+    overfull = []      # frontier beyond the pyramid, all at base_level
+
+    def split_through_pyramid(idx: int, lvl: int):
+        stack = [(idx, lvl)]
+        while stack:
+            i, l = stack.pop()
+            c = int(pyramid[l][i])
+            if c <= bucket_size or l >= KEY_BITS:
+                leaves.append((i, l))
+            elif l < base_level:
+                stack.extend((i * 8 + k, l + 1) for k in range(8))
+            else:
+                overfull.append(i)
+
+    split_through_pyramid(0, 0)
+
+    # drill rounds: refine every overfull cell ``sub`` levels at a time;
+    # the fetched depth-``sub`` counts are aggregated back up so splitting
+    # still happens one level at a time (a level+1 child under the bucket
+    # must become ONE leaf, not 8 over-refined grandchildren)
+    level = base_level
+    pending = overfull
+    while pending and level < KEY_BITS:
+        step = min(sub, KEY_BITS - level)
+        nsub = 1 << (3 * step)
+        nxt = []
+        for c0 in range(0, len(pending), k_cap):
+            chunk = np.sort(np.asarray(pending[c0 : c0 + k_cap], np.int64))
+            ids = np.full(k_cap, 2**30, np.int32)
+            ids[: len(chunk)] = chunk.astype(np.int32)
+            counts = np.asarray(
+                fetch(drill_histogram(
+                    keys_dev, jnp.asarray(ids), level, step, k_cap
+                ))
+            )
+            for r, cell in enumerate(chunk):
+                sums = [
+                    counts[r].reshape(1 << (3 * d), -1).sum(axis=1)
+                    for d in range(step + 1)
+                ]
+                stack = [(k, 1) for k in range(8)]  # cell is known overfull
+                while stack:
+                    i, d = stack.pop()
+                    c = int(sums[d][i])
+                    lvl = level + d
+                    if c <= bucket_size or lvl >= KEY_BITS:
+                        leaves.append((int(cell) * (1 << (3 * d)) + i, lvl))
+                    elif d < step:
+                        stack.extend((i * 8 + k, d + 1) for k in range(8))
+                    else:
+                        nxt.append(int(cell) * nsub + i)
+        pending = nxt
+        level += step
+
+    key_of = lambda idx, lvl: np.uint64(idx) << np.uint64(3 * (KEY_BITS - lvl))
+    starts = np.sort(np.asarray([key_of(i, l) for i, l in leaves], np.uint64))
+    return np.concatenate([starts, [np.uint64(1) << np.uint64(3 * KEY_BITS)]])

@@ -127,7 +127,13 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
     axis = cfg.shard_axis
     P = cfg.mesh.shape[axis]
     S_shard = state.x.shape[0] // P
-    Wmax = min(cfg.halo_window, S_shard) or S_shard
+    # full-slab windows: cfg.halo_window is sized from SPH 2h candidate
+    # spans, but the near field reaches the MAC radius (~2*leaf_edge/theta
+    # >> 2h) — an SPH-sized window would escape persistently and the
+    # retry loop could not converge by growing it. A measured
+    # gravity-specific window estimate is the open refinement
+    # (docs/NEXT.md); full slabs are always correct.
+    Wmax = S_shard
     gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g, use_pallas=True)
 
     def stage(box, keys, x, y, z, m, h):

@@ -56,6 +56,7 @@ def make_propagator_config(
     run_cap: int = 1536,
     gap: int = 384,
     group: int = 64,
+    device_sizing: bool = False,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -67,40 +68,69 @@ def make_propagator_config(
     sweep_engine.py): ~128-per-cell grids beat finer levels (fragmented
     short runs waste 128-lane chunks), and aggressive run merging cuts
     the per-group DMA count ~3x.
+
+    ``device_sizing``: compute every sizing statistic with jitted
+    reductions on the (possibly sharded) device arrays and fetch only
+    scalars — the O(N/P) path multi-device runs use (VERDICT r3 #3; the
+    reference's rank-local assignment, assignment.hpp:84-122). The
+    default host path keeps the native C++ runtime exercised
+    single-device.
     """
     if backend == "auto":
         # fused pallas kernels on TPU, portable gather path elsewhere
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
-    h = np.asarray(state.h)
-    h_max = float(h.max())
-    lengths = np.asarray(box.lengths)
-    level = choose_grid_level(lengths, h_max)
-    # group-window search covers the 2h radius at ANY level, so the level
-    # is free to target cell occupancy instead; below ~cell_target
-    # particles per cell the extra window cells stop paying for the
-    # tighter candidate volume
-    level_occ = max(
-        1, round(np.log2(max(state.n / float(cell_target), 1.0)) / 3.0)
-    )
-    level = min(level, level_occ)
-
-    # host-side sizing pass: one device->host transfer of the coordinates,
-    # then the native C++ runtime (sphexa_tpu/native) does keygen, sort and
-    # occupancy/window accounting (numpy/jax fallback inside)
-    from sphexa_tpu import native
-
-    xa = np.asarray(state.x)
-    ya = np.asarray(state.y)
-    za = np.asarray(state.z)
-    keys = native.compute_keys(xa, ya, za, np.asarray(box.lo), lengths, curve)
-    order = native.argsort_keys(keys)
     from sphexa_tpu.neighbors.cell_list import pad_cap, window_cells
 
-    cap = pad_cap(native.max_cell_occupancy(keys[order], level))
-    if min_cap > 0:
-        cap = max(cap, pad_cap(min_cap))  # quantized so retry caps cache
-    ncell = 1 << level
-    ext = native.group_extents(xa, ya, za, order, group)
+    if device_sizing:
+        from sphexa_tpu.parallel import sizing
+
+        lengths = np.asarray(sizing.fetch(box.lengths))
+        h_max = float(sizing.fetch(jnp.max(state.h)))
+        level = choose_grid_level(lengths, h_max)
+        level_occ = max(
+            1, round(np.log2(max(state.n / float(cell_target), 1.0)) / 3.0)
+        )
+        level = min(level, level_occ)
+        occ, ext_d, _ = sizing.sizing_stats(
+            state.x, state.y, state.z, state.h, box, level, group, curve
+        )
+        cap = pad_cap(int(sizing.fetch(occ)))
+        ext = np.asarray(sizing.fetch(ext_d))
+        if min_cap > 0:
+            cap = max(cap, pad_cap(min_cap))
+        ncell = 1 << level
+    else:
+        lengths = np.asarray(box.lengths)
+        h = np.asarray(state.h)
+        h_max = float(h.max())
+        level = choose_grid_level(lengths, h_max)
+        # group-window search covers the 2h radius at ANY level, so the
+        # level is free to target cell occupancy instead; below
+        # ~cell_target particles per cell the extra window cells stop
+        # paying for the tighter candidate volume
+        level_occ = max(
+            1, round(np.log2(max(state.n / float(cell_target), 1.0)) / 3.0)
+        )
+        level = min(level, level_occ)
+
+        # host-side sizing pass: one device->host transfer of the
+        # coordinates, then the native C++ runtime (sphexa_tpu/native)
+        # does keygen, sort and occupancy/window accounting (numpy/jax
+        # fallback inside)
+        from sphexa_tpu import native
+
+        xa = np.asarray(state.x)
+        ya = np.asarray(state.y)
+        za = np.asarray(state.z)
+        keys = native.compute_keys(xa, ya, za, np.asarray(box.lo), lengths,
+                                   curve)
+        order = native.argsort_keys(keys)
+
+        cap = pad_cap(native.max_cell_occupancy(keys[order], level))
+        if min_cap > 0:
+            cap = max(cap, pad_cap(min_cap))  # quantized so retry caps cache
+        ncell = 1 << level
+        ext = native.group_extents(xa, ya, za, order, group)
     # 10% radius slack absorbs drift between reconfigurations; a whole
     # margin cell costs ~2x window cells (every cell is a kernel iteration),
     # and the window_ok guard reconfigures if the slack is ever outgrown
@@ -171,12 +201,6 @@ class Simulation:
         if num_devices is not None and num_devices > 1:
             from sphexa_tpu.parallel import make_mesh, shard_state
 
-            if prop in ("turb-ve", "std-cooling"):
-                raise NotImplementedError(
-                    f"prop={prop!r} carries extra per-step state the "
-                    "sharded stepper does not thread yet; run it "
-                    "single-device or via the library GSPMD path"
-                )
             if state.n % num_devices:
                 raise ValueError(
                     f"particle count {state.n} not divisible by "
@@ -240,6 +264,12 @@ class Simulation:
                 self.cooling_cfg = CoolingConfig(gamma=const.gamma)
             if self.chem is None:
                 self.chem = ChemistryData.ionized(state.n)
+            if self._mesh is not None:
+                from sphexa_tpu.parallel import shard_state
+
+                # per-particle chemistry rides the slab sharding like the
+                # state (std_hydro_grackle.hpp runs under the full domain)
+                self.chem = shard_state(self.chem, self._mesh)
         self.iteration = 0
         # deferred cap-checking (check_every > 1): the happy path launches
         # steps without any device->host sync; diagnostics of the last
@@ -258,11 +288,22 @@ class Simulation:
 
     # -- static config management ------------------------------------------
     def _configure(self, min_cap: int = 0, grav_margin: float = 1.5):
+        if self._mesh is not None:
+            # drain in-flight steps before dispatching the sizing jits:
+            # those jits contain their own collectives, and on CPU meshes
+            # two concurrently executing programs' collective channels can
+            # collide (observed as an all-reduce rendezvous hang when a
+            # mid-run reconfigure overlapped the previous step)
+            jax.block_until_ready(jax.tree.leaves(self.state))
+        # multi-device: every sizing statistic comes from jitted device
+        # reductions (O(N/P) transfers, parallel/sizing.py); single-device
+        # keeps the native C++ host sizing pass
         self._cfg = make_propagator_config(
             self.state, self.box, self.const,
             ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
             av_clean=self.av_clean, keep_accels=self.keep_accels,
             keep_fields=self.keep_fields, backend=self.backend,
+            device_sizing=self._mesh is not None,
         )
         if self.gravity_on:
             self._configure_gravity(grav_margin)
@@ -275,60 +316,75 @@ class Simulation:
         into make_sharded_step. Called at every reconfiguration, so an
         escape-sentinel overflow grows the window via _halo_margin."""
         from sphexa_tpu.parallel import make_sharded_step
-        from sphexa_tpu.parallel.exchange import estimate_halo_window
-        from sphexa_tpu.propagator import _sort_by_keys
         from sphexa_tpu.sfc.box import make_global_box
 
         wmax = 0
         if self._cfg.backend == "pallas" and self.prop_name != "nbody":
-            # host-side sizing like _configure_gravity: only the four
-            # arrays the window scan reads are sorted (a full
-            # _sort_by_keys would permute every field for nothing)
-            from sphexa_tpu import native
+            # device-side discovery: the window scan runs as jitted
+            # scatter-min/max over the sharded arrays and ONE scalar
+            # reaches the host (parallel/sizing.py — the rank-local
+            # assignment analog, assignment.hpp:84-122)
+            from sphexa_tpu.parallel.sizing import device_halo_window
+            from sphexa_tpu.sfc.keys import compute_sfc_keys
 
-            gbox = make_global_box(self.state.x, self.state.y, self.state.z,
-                                   self.box)
-            xa = np.asarray(self.state.x)
-            ya = np.asarray(self.state.y)
-            za = np.asarray(self.state.z)
-            keys = native.compute_keys(
-                xa, ya, za, np.asarray(gbox.lo), np.asarray(gbox.lengths),
-                self.curve,
-            )
-            order = native.argsort_keys(keys)
-            wmax = estimate_halo_window(
-                jnp.asarray(xa[order]), jnp.asarray(ya[order]),
-                jnp.asarray(za[order]),
-                jnp.asarray(np.asarray(self.state.h)[order]),
-                jnp.asarray(keys[order]), gbox,
+            s = self.state
+            gbox = make_global_box(s.x, s.y, s.z, self.box)
+            keys = compute_sfc_keys(s.x, s.y, s.z, gbox, curve=self.curve)
+            wmax = device_halo_window(
+                s.x, s.y, s.z, s.h, keys, gbox,
                 self._cfg.nbr, P=self._mesh.size, margin=self._halo_margin,
             )
+        aux_cfg = None
+        if self.prop_name == "turb-ve":
+            aux_cfg = self.turb_cfg
+        elif self.prop_name == "std-cooling":
+            aux_cfg = self.cooling_cfg
         self._stepper = make_sharded_step(
             self._mesh, self._cfg, _PROPAGATORS[self.prop_name],
-            halo_window=wmax,
+            halo_window=wmax, aux_cfg=aux_cfg,
         )
 
     def _configure_gravity(self, margin: float):
         """(Re)build the gravity tree structure from the current particle
         distribution and size the interaction-list caps (the gravity analog
-        of re-sizing the neighbor cell grid — host work, reconfiguration
-        granularity only)."""
-        from sphexa_tpu import native
-
+        of re-sizing the neighbor cell grid — reconfiguration granularity
+        only). Single-device: native C++ host keygen/sort + host tree
+        build. Multi-device: the distributed histogram-pyramid build
+        (parallel/sizing.py — the update_mpi.hpp node-count allreduce
+        transposed) plus device-side sort/multipoles, so only O(#cells)
+        histograms and O(tree) arrays ever reach the host."""
         s = self.state
-        keys = native.compute_keys(
-            np.asarray(s.x), np.asarray(s.y), np.asarray(s.z),
-            np.asarray(self.box.lo), np.asarray(self.box.lengths), self.curve,
-        )
-        order = native.argsort_keys(keys)
-        skeys = jnp.asarray(keys[order])
-        xs = jnp.asarray(np.asarray(s.x)[order])
-        ys = jnp.asarray(np.asarray(s.y)[order])
-        zs = jnp.asarray(np.asarray(s.z)[order])
-        ms = jnp.asarray(np.asarray(s.m)[order])
-        gtree, meta = build_gravity_tree(
-            keys[order], bucket_size=self.grav_bucket, curve=self.curve
-        )
+        if self._mesh is not None:
+            from sphexa_tpu.gravity.tree import linkage_from_leaves
+            from sphexa_tpu.parallel.sizing import leaf_array_from_device_keys
+            from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+            keys_d = compute_sfc_keys(s.x, s.y, s.z, self.box,
+                                      curve=self.curve)
+            leaf_tree = leaf_array_from_device_keys(
+                keys_d, bucket_size=self.grav_bucket
+            )
+            gtree, meta = linkage_from_leaves(leaf_tree, curve=self.curve)
+            order = jnp.argsort(keys_d)
+            skeys = keys_d[order]
+            xs, ys, zs, ms = s.x[order], s.y[order], s.z[order], s.m[order]
+        else:
+            from sphexa_tpu import native
+
+            keys = native.compute_keys(
+                np.asarray(s.x), np.asarray(s.y), np.asarray(s.z),
+                np.asarray(self.box.lo), np.asarray(self.box.lengths),
+                self.curve,
+            )
+            order = native.argsort_keys(keys)
+            skeys = jnp.asarray(keys[order])
+            xs = jnp.asarray(np.asarray(s.x)[order])
+            ys = jnp.asarray(np.asarray(s.y)[order])
+            zs = jnp.asarray(np.asarray(s.z)[order])
+            ms = jnp.asarray(np.asarray(s.m)[order])
+            gtree, meta = build_gravity_tree(
+                keys[order], bucket_size=self.grav_bucket, curve=self.curve
+            )
         gcfg = estimate_gravity_caps(
             xs, ys, zs, ms, skeys, self.box, gtree, meta,
             GravityConfig(theta=self.theta, bucket_size=self.grav_bucket,
@@ -347,6 +403,12 @@ class Simulation:
         )
 
     def _gravity_overflowed(self, diagnostics) -> bool:
+        # the sharded near field always runs full-slab halo windows
+        # (_gravity_sharded_stage) and the run splitter sizes its slots
+        # from the mesh (exchange._split_runs extra=max(8, P-1)), so its
+        # escape sentinel cannot fire — any p2p_max > p2p_cap here is a
+        # REAL interaction-list overflow and cap regrowth is the right
+        # recovery
         if not self.gravity_on:
             return False
         g = self._cfg.gravity
@@ -369,12 +431,39 @@ class Simulation:
         return 2.0 * h_max <= cell_edge
 
     # -- main loop ----------------------------------------------------------
+    def _drain(self, out):
+        """CPU-mesh collective serialization: a program's scalar outputs
+        can materialize before its trailing collectives retire, and a
+        second program entering the per-thread queues mid-flight deadlocks
+        the all-reduce rendezvous (observed: evrard-cooling CLI hang).
+        Real TPU meshes execute programs FIFO per core — no drain there."""
+        if self._mesh is not None and jax.default_backend() == "cpu":
+            jax.block_until_ready(
+                [a for a in jax.tree.leaves(out) if hasattr(a, "block_until_ready")]
+            )
+        return out
+
     def _launch(self):
-        """Dispatch one jitted step on the current state (no host sync).
-        Returns (new_state, new_box, diagnostics, new_turb, new_chem)."""
+        """Dispatch one jitted step on the current state (no host sync
+        beyond the CPU-mesh drain). Returns (new_state, new_box,
+        diagnostics, new_turb, new_chem)."""
         if self._mesh is not None:
-            new_state, new_box, diagnostics = self._stepper(
-                self.state, self.box, self._gtree
+            if self.prop_name == "turb-ve":
+                new_state, new_box, diagnostics, new_turb = self._drain(
+                    self._stepper(
+                        self.state, self.box, self._gtree, self.turb_state
+                    )
+                )
+                return new_state, new_box, diagnostics, new_turb, None
+            if self.prop_name == "std-cooling":
+                new_state, new_box, diagnostics, new_chem = self._drain(
+                    self._stepper(
+                        self.state, self.box, self._gtree, self.chem
+                    )
+                )
+                return new_state, new_box, diagnostics, None, new_chem
+            new_state, new_box, diagnostics = self._drain(
+                self._stepper(self.state, self.box, self._gtree)
             )
             return new_state, new_box, diagnostics, None, None
         step_fn = _PROPAGATORS[self.prop_name]

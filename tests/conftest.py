@@ -17,16 +17,9 @@ import os
 # SPHEXA_TPU_TESTS=1 keeps the real TPU backend (for the device-equivalence
 # tier, tests/test_pallas_tpu.py).
 if not os.environ.get("SPHEXA_TPU_TESTS"):
-    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
+    from sphexa_tpu.util.cpu_mesh import force_cpu_mesh
 
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
+    force_cpu_mesh(8)
 
 import numpy as np
 import pytest

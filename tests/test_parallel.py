@@ -6,6 +6,7 @@ oversubscribed-mpiexec integration tests (domain/test/integration_mpi/).
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 import pytest
 
 from sphexa_tpu.init import init_sedov
@@ -282,6 +283,78 @@ class TestShardedVE:
             rtol=1e-4, atol=1e-6,
         )
 
+    def test_sharded_turb_ve_matches_single(self):
+        """turb-ve through the sharded stepper (VERDICT r3 #5): the VE
+        force stage runs per-shard Mosaic kernels, the OU stirring is
+        GSPMD-partitioned XLA, and the advanced TurbulenceState pytree is
+        threaded through (turb_ve.hpp:53 runs under the full domain)."""
+        from sphexa_tpu.propagator import step_turb_ve
+        from sphexa_tpu.sph.hydro_turb import create_stirring_modes
+
+        state, box, const = init_sedov(16)
+        tcfg, turb = create_stirring_modes(lbox=1.0, st_max_modes=200)
+        cfg = make_propagator_config(state, box, const, block=512,
+                                     backend="pallas")
+        ref_state, _, ref_diag, ref_turb = step_turb_ve(
+            state, box, cfg, None, turb, tcfg
+        )
+
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg, step_fn=step_turb_ve,
+                                 aux_cfg=tcfg)
+        out_state, _, out_diag, out_turb = step(sstate, box, None, turb)
+        assert out_state.x.sharding.spec == jax.sharding.PartitionSpec("p")
+        np.testing.assert_allclose(
+            np.asarray(out_state.vx), np.asarray(ref_state.vx),
+            rtol=1e-4, atol=1e-6,
+        )
+        # the OU phase advance must agree exactly (same dt, same RNG path)
+        np.testing.assert_allclose(
+            np.asarray(out_turb.phases), np.asarray(ref_turb.phases),
+            rtol=1e-6, atol=1e-9,
+        )
+        np.testing.assert_allclose(
+            float(out_diag["dt"]), float(ref_diag["dt"]), rtol=1e-5
+        )
+
+    def test_sharded_std_cooling_matches_single(self):
+        """std-cooling through the sharded stepper (VERDICT r3 #5): the
+        per-particle ChemistryData rides the slab sharding and the
+        in-step SFC sort (std_hydro_grackle.hpp:56)."""
+        from sphexa_tpu.physics.cooling import ChemistryData, CoolingConfig
+        from sphexa_tpu.propagator import step_hydro_std_cooling
+
+        state, box, const = init_sedov(16)
+        ccfg = CoolingConfig(gamma=const.gamma)
+        chem = ChemistryData.ionized(state.n)
+        cfg = make_propagator_config(state, box, const, block=512,
+                                     backend="pallas")
+        ref_state, _, ref_diag, ref_chem = step_hydro_std_cooling(
+            state, box, cfg, None, chem, ccfg
+        )
+
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        schem = shard_state(chem, mesh)
+        step = make_sharded_step(mesh, cfg, step_fn=step_hydro_std_cooling,
+                                 aux_cfg=ccfg)
+        out_state, _, out_diag, out_chem = step(sstate, box, None, schem)
+        assert out_state.x.sharding.spec == jax.sharding.PartitionSpec("p")
+        np.testing.assert_allclose(
+            np.asarray(out_state.temp), np.asarray(ref_state.temp),
+            rtol=1e-4, atol=1e-7,
+        )
+        # chemistry stays aligned with the sorted state and slab-sharded
+        assert out_chem.hi.sharding.spec == jax.sharding.PartitionSpec("p")
+        np.testing.assert_allclose(
+            np.asarray(out_chem.hi), np.asarray(ref_chem.hi),
+            rtol=1e-5, atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            float(out_diag["dt"]), float(ref_diag["dt"]), rtol=1e-5
+        )
+
 
 class TestShardedNbody:
     """Gravity-only N-body under the sharded step (the sharded-nbody
@@ -426,3 +499,138 @@ class TestSimulationMesh:
         state, box, const = init_sedov(15)  # 3375 % 8 != 0
         with pytest.raises(ValueError, match="not divisible"):
             Simulation(state, box, const, num_devices=8)
+
+
+class TestDeviceSizing:
+    """O(N/P) reconfiguration (VERDICT r3 #3): multi-device sizing runs as
+    jitted device reductions; only scalars, O(#cells) histograms and
+    O(tree) arrays reach the host. The reference's counterpart is the
+    allreduce-incremental tree count (update_mpi.hpp:26-106) + rank-local
+    assignment (assignment.hpp:84-122)."""
+
+    def test_pyramid_tree_matches_host_build(self):
+        from sphexa_tpu.parallel.sizing import leaf_array_from_device_keys
+        from sphexa_tpu.sfc.keys import compute_sfc_keys
+        from sphexa_tpu.tree.csarray import compute_octree
+
+        state, box, const = init_sedov(16)
+        keys = compute_sfc_keys(state.x, state.y, state.z, box)
+        ref, _ = compute_octree(
+            np.sort(np.asarray(keys, np.uint64)), bucket_size=64
+        )
+        # unsorted device keys: the histogram build never needs the sort
+        got = leaf_array_from_device_keys(keys, bucket_size=64)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_pyramid_tree_matches_host_build_clustered(self):
+        # deep drill-down coverage: a tight cluster forces refinement well
+        # past the base histogram level
+        from sphexa_tpu.parallel.sizing import leaf_array_from_device_keys
+        from sphexa_tpu.sfc.keys import compute_sfc_keys
+        from sphexa_tpu.tree.csarray import compute_octree
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(7)
+        n = 20000
+        # half uniform, half in a 1e-3-wide cluster
+        pts = np.concatenate([
+            rng.uniform(0, 1, (n // 2, 3)),
+            0.5 + 1e-3 * rng.uniform(0, 1, (n // 2, 3)),
+        ])
+        state, box, const = init_sedov(8)
+        keys = compute_sfc_keys(
+            jnp.asarray(pts[:, 0], jnp.float32),
+            jnp.asarray(pts[:, 1], jnp.float32),
+            jnp.asarray(pts[:, 2], jnp.float32), box)
+        ref, _ = compute_octree(
+            np.sort(np.asarray(keys, np.uint64)), bucket_size=64
+        )
+        got = leaf_array_from_device_keys(keys, bucket_size=64)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_sizing_stats_matches_host(self):
+        from sphexa_tpu.parallel import sizing
+        from sphexa_tpu import native
+        from sphexa_tpu.neighbors.cell_list import pad_cap
+
+        state, box, const = init_sedov(12)
+        level, group = 3, 64
+        occ, ext, h_max = jax.device_get(sizing.sizing_stats(
+            state.x, state.y, state.z, state.h, box, level, group
+        ))
+        xa, ya, za = (np.asarray(a) for a in (state.x, state.y, state.z))
+        keys = native.compute_keys(
+            xa, ya, za, np.asarray(box.lo), np.asarray(box.lengths),
+            "hilbert")
+        order = native.argsort_keys(keys)
+        assert int(occ) == native.max_cell_occupancy(keys[order], level)
+        ref_ext = native.group_extents(xa, ya, za, order, group)
+        np.testing.assert_allclose(np.asarray(ext), ref_ext, rtol=1e-6)
+        assert float(h_max) == float(np.asarray(state.h).max())
+
+    def test_device_halo_window_matches_host(self):
+        from sphexa_tpu.parallel.exchange import estimate_halo_window
+        from sphexa_tpu.parallel.sizing import device_halo_window
+        from sphexa_tpu.sfc.keys import compute_sfc_keys
+        from sphexa_tpu.simulation import make_propagator_config
+
+        state, box, const = init_sedov(16)
+        cfg = make_propagator_config(state, box, const, block=512)
+        keys = compute_sfc_keys(state.x, state.y, state.z, box)
+        order = np.argsort(np.asarray(keys))
+        xs = jnp.asarray(np.asarray(state.x)[order])
+        ys = jnp.asarray(np.asarray(state.y)[order])
+        zs = jnp.asarray(np.asarray(state.z)[order])
+        hs = jnp.asarray(np.asarray(state.h)[order])
+        sk = jnp.asarray(np.asarray(keys)[order])
+        ref = estimate_halo_window(xs, ys, zs, hs, sk, box, cfg.nbr, P=8)
+        got = device_halo_window(state.x, state.y, state.z, state.h,
+                                 keys, box, cfg.nbr, P=8)
+        assert got == ref
+
+    def test_mesh_configure_transfers_o_n_over_p(self):
+        """The VERDICT 'Done' gate: a num_devices=8 gravity run's
+        (re)configure moves O(N/P) bytes to the host — asserted with the
+        sizing transfer counter, under a device-to-host transfer guard so
+        any stray implicit full-array gather fails the test."""
+        from sphexa_tpu.init import init_evrard
+        from sphexa_tpu.parallel import sizing
+        from sphexa_tpu.simulation import Simulation
+
+        state, box, const = init_evrard(12, overrides={"G": 1.0})
+        n8 = (state.n // 8) * 8
+        state = jax.tree.map(
+            lambda a: a[:n8] if getattr(a, "ndim", 0) == 1 else a, state
+        )
+        sizing.reset_transfer_bytes()
+        # tripwire: on the CPU mesh the jax transfer guard is inert
+        # (host arrays are zero-copy), so catch unmetered full-array
+        # gathers by intercepting numpy coercion of large jax arrays —
+        # every legitimate fetch in the device-sizing path goes through
+        # sizing.fetch (which yields numpy before np.asarray sees it)
+        import unittest.mock as mock
+
+        real_asarray = np.asarray
+        limit = state.x.nbytes // 4  # anything >= N/4 rows is a gather
+
+        def guarded(a, *args, **kw):
+            if isinstance(a, jax.Array) and a.nbytes >= limit:
+                raise AssertionError(
+                    f"unmetered device->host gather of {a.nbytes} bytes"
+                )
+            return real_asarray(a, *args, **kw)
+
+        with mock.patch("numpy.asarray", side_effect=guarded), \
+                jax.transfer_guard_device_to_host("disallow"):
+            sim = Simulation(state, box, const, prop="nbody",
+                             num_devices=8, backend="xla")
+        state_bytes = sum(
+            a.nbytes for a in jax.tree.leaves(sim.state)
+            if hasattr(a, "nbytes")
+        )
+        # O(N/P) + O(#cells + tree): generous constant, but far below the
+        # full-state gather the host path would need
+        budget = state_bytes // 8 + 2_000_000
+        assert sizing.TRANSFER_BYTES < budget, (
+            sizing.TRANSFER_BYTES, budget
+        )
