@@ -101,19 +101,14 @@ def run(dtype, label):
         out_shape=jax.ShapeDtypeStruct((NG, 8, G), jnp.float32),
         scratch_shapes=[pltpu.VMEM((G, 128), jnp.float32) for _ in range(4)],
     )
-    # in_specs deliver (1, 8, G) blocks; kernel indexes [0] -> (8, G)? No:
-    # block shape (1, 8, G) gives ref shape (1, 8, G); squeeze via [0].
-    def wrap(i, j):
-        return call(i, j)
-
     i = jax.random.normal(jax.random.PRNGKey(0), (NG, 8, G), jnp.float32)
     i = i.at[:, 3].set(jnp.abs(i[:, 3]) + 0.5)
     j = jax.random.normal(jax.random.PRNGKey(1), (CHUNKS, 8, 128), jnp.float32)
-    out = wrap(i, j)
+    out = call(i, j)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        out = wrap(i, j)
+        out = call(i, j)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / ITERS
     lanes = NG * G * CHUNKS * 128

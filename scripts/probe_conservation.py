@@ -1,0 +1,113 @@
+"""Conservation probes at the reference config (sedov 50^3, 200 steps).
+
+Per-step energy-budget decomposition in f64 (host):
+
+  A = d_ekin - dt*sum m a.v_mid     Press-scheme kinetic truncation
+  B = d_eint - dt*sum m du          AB2 internal-energy correction term
+  C = dt*(sum m du + sum m a.v_mid) force antisymmetry + v-centering
+
+  d_etot(step) = A + B + C exactly (f64 identity on the f32 states).
+
+v_mid = (v^n + v^{n+1})/2 with v^n re-ordered into the post-step sort
+order via argsort of the pre-step keys (sedov box is periodic => the
+in-step box is unchanged and the permutation reproducible).
+
+P1 dt-scaling: 200-step drift with k_cour x {1.0, 0.5}: ratio ~2 =>
+   first-order integrator loss; ~4 => second order; ~1 => dt-independent.
+
+Usage: python scripts/probe_conservation.py [ve|std] [decomp|scale]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.observables import conserved_quantities
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+from sphexa_tpu.simulation import Simulation
+
+PROP = sys.argv[1] if len(sys.argv) > 1 else "ve"
+MODE = sys.argv[2] if len(sys.argv) > 2 else "decomp"
+STEPS = int(os.environ.get("PROBE_STEPS", "200"))
+SIDE = int(os.environ.get("PROBE_SIDE", "50"))
+
+
+def f64(a):
+    return np.asarray(a, np.float64)
+
+
+def energies(st, const):
+    m = f64(st.m)
+    ekin = 0.5 * np.sum(m * (f64(st.vx) ** 2 + f64(st.vy) ** 2
+                             + f64(st.vz) ** 2))
+    eint = np.sum(m * float(const.cv) * f64(st.temp))
+    return ekin, eint
+
+
+def decomp():
+    state, box, const = init_sedov(SIDE)
+    sim = Simulation(state, box, const, prop=PROP, block=8192,
+                     check_every=1, keep_accels=True)
+    probe_at = {60, 100, 140, 180}
+    cum = dict(A=0.0, B=0.0, C=0.0)
+    e0k, e0i = energies(sim.state, const)
+    e0 = e0k + e0i
+    for s in range(STEPS):
+        st = sim.state
+        keys = np.asarray(compute_sfc_keys(st.x, st.y, st.z, sim.box))
+        order = np.argsort(keys, kind="stable")
+        vxn, vyn, vzn = (f64(st.vx)[order], f64(st.vy)[order],
+                         f64(st.vz)[order])
+        ekin0, eint0 = energies(st, const)
+        d = sim.step()
+        st2 = sim.state
+        if "ax" not in d:
+            print("no accels in diag; keys:", sorted(d)); return
+        dt = float(st2.min_dt)
+        m = f64(st2.m)
+        ax, ay, az = f64(d["ax"]), f64(d["ay"]), f64(d["az"])
+        du = f64(st2.du)
+        vmx = 0.5 * (vxn + f64(st2.vx))
+        vmy = 0.5 * (vyn + f64(st2.vy))
+        vmz = 0.5 * (vzn + f64(st2.vz))
+        work = dt * np.sum(m * (ax * vmx + ay * vmy + az * vmz))
+        heat = dt * np.sum(m * du)
+        ekin1, eint1 = energies(st2, const)
+        A = (ekin1 - ekin0) - work
+        B = (eint1 - eint0) - heat
+        C = heat + work
+        for k, v in zip("ABC", (A, B, C)):
+            cum[k] += v
+        if s in probe_at or s == STEPS - 1:
+            etot = ekin1 + eint1
+            print(f"step {s:3d} dt={dt:.2e} drift={abs(etot-e0)/e0:.3e} "
+                  f"A={cum['A']/e0:+.3e} B={cum['B']/e0:+.3e} "
+                  f"C={cum['C']/e0:+.3e} "
+                  f"(step: A={A/e0:+.2e} B={B/e0:+.2e} C={C/e0:+.2e})",
+                  flush=True)
+
+
+def scale():
+    for ks in (1.0, 0.5):
+        state, box, const = init_sedov(SIDE)
+        const2 = dataclasses.replace(const, k_cour=const.k_cour * ks)
+        sim = Simulation(state, box, const2, prop=PROP, block=8192,
+                         check_every=10)
+        e0 = float(conserved_quantities(sim.state, const2)["etot"])
+        for _ in range(STEPS):
+            sim.step()
+        sim.flush()
+        e1 = float(conserved_quantities(sim.state, const2)["etot"])
+        print(f"[{PROP}] k_cour x{ks}: drift={abs(e1-e0)/abs(e0):.3e} "
+              f"t={float(sim.state.ttot):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    decomp() if MODE == "decomp" else scale()

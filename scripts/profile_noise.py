@@ -1,0 +1,65 @@
+"""Run-to-run variance probe: time the SAME fused std pipeline 8 times."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.simulation import Simulation, make_propagator_config
+from sphexa_tpu.sfc.box import make_global_box
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+from sphexa_tpu.propagator import _sort_by_keys
+from sphexa_tpu.sph import hydro_std
+from sphexa_tpu.sph import pallas_pairs as pp
+
+SIDE = int(os.environ.get("PROF_SIDE", "100"))
+
+
+def main():
+    state, box, const = init_sedov(SIDE)
+    sim = Simulation(state, box, const, prop="std", block=8192)
+    for _ in range(2):
+        sim.step()
+    state, box = sim.state, sim.box
+    box = make_global_box(state.x, state.y, state.z, box)
+    state, _, _ = _sort_by_keys(state, box, "hilbert")
+
+    cfg = make_propagator_config(state, box, const, block=8192,
+                                 backend="pallas")
+    nbr = cfg.nbr
+
+    @jax.jit
+    def pipe(x, y, z, h, m, temp, vx, vy, vz):
+        keys = jnp.sort(compute_sfc_keys(x, y, z, box))
+        ranges = pp.group_cell_ranges(x, y, z, h, keys, box, nbr)
+        rho, nc, occ = pp.pallas_density(
+            x, y, z, h, m, keys, box, const, nbr, ranges=ranges)
+        p, c = hydro_std.compute_eos_std(temp, rho, const)
+        cs, _ = pp.pallas_iad(
+            x, y, z, h, m / rho, keys, box, const, nbr, ranges=ranges)
+        out = pp.pallas_momentum_energy_std(
+            x, y, z, vx, vy, vz, h, m, rho, p, c, *cs,
+            keys, box, const, nbr, ranges=ranges)
+        return out[0]
+
+    args = (state.x, state.y, state.z, state.h, state.m, state.temp,
+            state.vx, state.vy, state.vz)
+    out = pipe(*args)
+    jax.block_until_ready(out)
+    for r in range(8):
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = pipe(*args)
+        jax.block_until_ready(out)
+        _ = float(jnp.sum(out))
+        dt = (time.perf_counter() - t0) / 3
+        print(f"run {r}: {dt*1e3:8.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -212,14 +212,20 @@ def main(argv=None) -> int:
         # truncate at an arbitrary boundary — this moves it by < P rows)
         import jax as _jax
 
-        keep = (state.n // args.devices) * args.devices
-        print(f"# trimming {state.n - keep} trailing particles for an "
+        n_full = state.n
+        keep = (n_full // args.devices) * args.devices
+        print(f"# trimming {n_full - keep} trailing particles for an "
               f"even {args.devices}-way slab decomposition", file=sys.stderr)
-        state = _jax.tree.map(
+        trim = lambda tree: _jax.tree.map(
             lambda a: a[:keep] if getattr(a, "ndim", 0) >= 1
-            and a.shape[0] == state.n else a,
-            state,
+            and a.shape[0] == n_full else a,
+            tree,
         )
+        state = trim(state)
+        # per-particle aux state (std-cooling chemistry) must stay
+        # row-aligned with the trimmed particle arrays
+        if chem_restored is not None:
+            chem_restored = trim(chem_restored)
     try:
         sim = Simulation(state, box, const, prop=args.prop,
                          av_clean=args.avclean and args.prop in ("ve", "turb-ve"),

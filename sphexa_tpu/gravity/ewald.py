@@ -193,11 +193,11 @@ def _k_space_correction(dr, mass, q, L, cfg: EwaldConfig):
     return u, a
 
 
-@functools.partial(jax.jit, static_argnames=("meta", "cfg", "ecfg"))
+@functools.partial(jax.jit, static_argnames=("meta", "cfg", "ecfg", "shard"))
 def compute_gravity_ewald(
     x, y, z, m, h, sorted_keys, box: Box,
     tree: GravityTree, meta: GravityTreeMeta, cfg: GravityConfig,
-    ecfg: EwaldConfig,
+    ecfg: EwaldConfig, shard=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
     """Periodic-box gravity: replica near field + Ewald corrections.
 
@@ -205,6 +205,14 @@ def compute_gravity_ewald(
     Barnes-Hut pass per replica shell offset ((2r+1)^3 passes, each a
     static jit region), matching computeGravityEwald's use of
     computeGravity(..., numReplicaShells).
+
+    ``shard``: (axis, P, Wmax) when running INSIDE shard_map on a local
+    slab (same contract as compute_gravity): the upsweep is the psum
+    leaf-payload allreduce, each replica-shell near field rides the
+    windowed halo exchange (full-slab windows — shifted targets reach
+    wrap-around leaves anywhere in the box), and the per-particle
+    real/k-space corrections are row-local (the root expansion is
+    replicated by the psum). egrav and diagnostics return per-shard.
     """
     L = box.lengths[0]
     n = x.shape[0]
@@ -215,7 +223,14 @@ def compute_gravity_ewald(
             "spherical multipoles are open-boundary only; the Ewald path "
             "keeps the cartesian quadrupole (traversal_ewald_cpu.hpp parity)"
         )
-    mp_cache = compute_multipoles(x, y, z, m, sorted_keys, tree, meta)
+    if shard is not None:
+        from sphexa_tpu.gravity.traversal import compute_multipoles_sharded
+
+        mp_cache = compute_multipoles_sharded(
+            x, y, z, m, sorted_keys, tree, meta, shard[0]
+        )
+    else:
+        mp_cache = compute_multipoles(x, y, z, m, sorted_keys, tree, meta)
     node_mass, node_com, node_q, _ = mp_cache
 
     # replica near field: ONE traced traversal scanned over the static
@@ -234,6 +249,7 @@ def compute_gravity_ewald(
         dax, day, daz, dphi, d = compute_gravity(
             x, y, z, m, h, sorted_keys, box, tree, meta, cfg1,
             shift=shift, allow_self=~base, with_phi=True, mp_cache=mp_cache,
+            shard=shard,
         )
         dmax = {k: jnp.maximum(dmax[k], d[k]) for k in dmax}
         return (ax + dax, ay + day, az + daz, phi + dphi, dmax), None

@@ -110,13 +110,13 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
         else:
             cfg = dataclasses.replace(cfg, backend="xla")
     if (cfg.gravity is not None and cfg.gravity.use_pallas
-            and (cfg.shard_axis is None or cfg.ewald is not None)):
+            and cfg.shard_axis is None):
         # on the GSPMD path (nbody/turb/cooling/xla steps) gravity runs
         # outside any shard_map, where a Mosaic custom call has no
         # partitioning rule — fall back to the XLA near field there. The
         # fast-path steps instead run _gravity_sharded_stage (distributed
-        # upsweep + windowed near-field halos) with the engine inside
-        # shard_map.
+        # upsweep + windowed near-field halos, Ewald replica shells
+        # included) with the engine inside shard_map.
         cfg = dataclasses.replace(
             cfg, gravity=dataclasses.replace(cfg.gravity, use_pallas=False)
         )

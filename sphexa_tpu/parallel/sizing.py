@@ -10,7 +10,7 @@ mesh — and only SCALARS or O(#cells) histograms ever reach the host.
 
 Three groups of helpers:
 
-- ``sizing_stats``: max cell occupancy, per-dim group extents, h_max — the
+- ``sizing_stats``: max cell occupancy + per-dim group extents — the
   inputs of make_propagator_config's level/cap/window choice.
 - ``device_halo_window``: the per-(dest, src) shard row-window maximum that
   sizes the windowed all_to_all exchange (parallel/exchange.py), computed
@@ -62,10 +62,12 @@ def fetch(x):
 
 
 @functools.partial(jax.jit, static_argnames=("level", "group", "curve"))
-def sizing_stats(x, y, z, h, box, level: int, group: int,
+def sizing_stats(x, y, z, box, level: int, group: int,
                  curve: str = "hilbert"):
-    """(occ_max, ext (3,), h_max): everything make_propagator_config needs
-    beyond n — one jitted pass, five scalars to the host."""
+    """(occ_max, ext (3,)): the per-level stats make_propagator_config
+    needs beyond n and h_max (h_max must be fetched BEFORE this call —
+    ``level`` is static and derives from it) — one jitted pass, four
+    scalars to the host."""
     from sphexa_tpu.sfc.keys import compute_sfc_keys
 
     keys = compute_sfc_keys(x, y, z, box, curve=curve)
@@ -88,7 +90,7 @@ def sizing_stats(x, y, z, h, box, level: int, group: int,
         return jnp.max(g.max(axis=1) - g.min(axis=1))
 
     ext = jnp.stack([ext_of(x), ext_of(y), ext_of(z)])
-    return occ, ext, jnp.max(h)
+    return occ, ext
 
 
 # ---------------------------------------------------------------------------

@@ -119,7 +119,9 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
     """Distributed gravity under shard_map: psum multipole upsweep (the
     global_multipole.hpp allreduce analog — O(tree) comm, no particle
     replication), per-shard MAC/M2P on the replicated coarse tree, and
-    the near field through the windowed halo exchange."""
+    the near field through the windowed halo exchange. Covers the open
+    Barnes-Hut solve (any multipole order) and the periodic Ewald path
+    (cartesian quadrupole, traversal_ewald_cpu.hpp parity)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec
     from sphexa_tpu.gravity.traversal import compute_multipoles_sharded
@@ -136,21 +138,39 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
     Wmax = S_shard
     gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g, use_pallas=True)
 
-    def stage(box, keys, x, y, z, m, h):
-        mpc = compute_multipoles_sharded(
-            x, y, z, m, keys, gtree, cfg.grav_meta, axis
-        )
-        gx, gy, gz, egrav, diag = compute_gravity(
-            x, y, z, m, h, keys, box, gtree, cfg.grav_meta, gcfg,
-            mp_cache=mpc, shard=(axis, P, Wmax),
-        )
-        egrav = jax.lax.psum(egrav, axis)
-        diag = {k: jax.lax.pmax(v, axis) for k, v in diag.items()}
-        return gx, gy, gz, egrav, diag
+    if cfg.ewald is not None:
+
+        def stage(box, keys, x, y, z, m, h):
+            gx, gy, gz, egrav, diag = compute_gravity_ewald(
+                x, y, z, m, h, keys, box, gtree, cfg.grav_meta, gcfg,
+                cfg.ewald, shard=(axis, P, Wmax),
+            )
+            egrav = jax.lax.psum(egrav, axis)
+            diag = {k: jax.lax.pmax(v, axis) for k, v in diag.items()}
+            return gx, gy, gz, egrav, diag
+
+        dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
+                 "leaf_occ": PartitionSpec(), "c_max": PartitionSpec()}
+    else:
+
+        def stage(box, keys, x, y, z, m, h):
+            mpc = compute_multipoles_sharded(
+                x, y, z, m, keys, gtree, cfg.grav_meta, axis,
+                order=gcfg.multipole_order,
+            )
+            gx, gy, gz, egrav, diag = compute_gravity(
+                x, y, z, m, h, keys, box, gtree, cfg.grav_meta, gcfg,
+                mp_cache=mpc, shard=(axis, P, Wmax),
+            )
+            egrav = jax.lax.psum(egrav, axis)
+            diag = {k: jax.lax.pmax(v, axis) for k, v in diag.items()}
+            return gx, gy, gz, egrav, diag
+
+        dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
+                 "leaf_occ": PartitionSpec(), "c_max": PartitionSpec(),
+                 "mac_work_ratio": PartitionSpec()}
 
     Pp, Pr = PartitionSpec(axis), PartitionSpec()
-    dspec = {"m2p_max": Pr, "p2p_max": Pr, "leaf_occ": Pr, "c_max": Pr,
-             "mac_work_ratio": Pr}
     return shard_map(
         stage,
         mesh=cfg.mesh,
@@ -168,15 +188,15 @@ def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
     SFC-sorted arrays the step just produced. Returns updated accels,
     egrav, the acceleration dt candidate, and solver diagnostics.
     """
-    if cfg.ewald is not None:
+    if cfg.shard_axis is not None:
+        gx, gy, gz, egrav, gdiag = _gravity_sharded_stage(
+            state, box, cfg, gtree, keys
+        )
+    elif cfg.ewald is not None:
         gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g)
         gx, gy, gz, egrav, gdiag = compute_gravity_ewald(
             state.x, state.y, state.z, state.m, state.h, keys, box,
             gtree, cfg.grav_meta, gcfg, cfg.ewald,
-        )
-    elif cfg.shard_axis is not None:
-        gx, gy, gz, egrav, gdiag = _gravity_sharded_stage(
-            state, box, cfg, gtree, keys
         )
     else:
         gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g)

@@ -91,8 +91,8 @@ def make_propagator_config(
             1, round(np.log2(max(state.n / float(cell_target), 1.0)) / 3.0)
         )
         level = min(level, level_occ)
-        occ, ext_d, _ = sizing.sizing_stats(
-            state.x, state.y, state.z, state.h, box, level, group, curve
+        occ, ext_d = sizing.sizing_stats(
+            state.x, state.y, state.z, box, level, group, curve
         )
         cap = pad_cap(int(sizing.fetch(occ)))
         ext = np.asarray(sizing.fetch(ext_d))

@@ -57,6 +57,15 @@ from sphexa_tpu.sph.kernels import (
 
 GROUP = 128  # default targets per group (NeighborConfig.group overrides)
 
+# chunks processed per inner-loop trip: 2 = the pair math runs on (G, 256)
+# tiles (two 128-lane chunks). MEASURED SLOWER on v5e (467 vs 410 ms for
+# the std Sedov 100^3 pipeline): the per-field lane concats cost more than
+# the halved loop overhead saves — the per-chunk overhead is accumulator
+# read-modify-write + field loads, which pairing cannot reduce. Kept as an
+# env knob for future hardware; default 1. (docs/NEXT.md round-4 notes.)
+import os as _os
+CHUNK_PAIR = int(_os.environ.get("SPHEXA_CHUNK_PAIR", "1"))
+
 
 class PairGeom(NamedTuple):
     """Per-(target, candidate) geometry handed to the pair body."""
@@ -460,6 +469,8 @@ def group_pair_engine(
     """
     R = _dma_rows(cfg.dma_cap)
     nf_pad = _round_up(num_j, 8)
+    CW = max(1, CHUNK_PAIR)  # chunks per inner-loop trip
+    LW = 128 * CW            # lane width of the pair-math tiles
     if chunk_skip is None:
         # bitmask bits live in one int32, so the DMA window must fit 31
         # chunks; beyond that (huge run_cap) the cull is simply skipped
@@ -487,8 +498,12 @@ def group_pair_engine(
 
         def dma(w, slot):
             row_s = starts[0, 0, w] // 128
+            # dst slices off the CW-1 tail pad rows (uninitialized garbage
+            # the odd-tail paired read may touch — every accumulation is
+            # mask-selected, so garbage never reaches an output)
             return pltpu.make_async_copy(
-                jref.at[pl.ds(row_s, R), :, :], buf.at[slot], sems.at[slot]
+                jref.at[pl.ds(row_s, R), :, :],
+                buf.at[slot, pl.ds(0, R)], sems.at[slot]
             )
 
         def dma_aabb(w, slot):
@@ -519,7 +534,7 @@ def group_pair_engine(
             ioff[0, 0, 0] + gi * G
             + jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
         )
-        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, LW), 1)
         h4 = 4.0 * hi * hi
         lx, ly, lz = boxl[0, 0, 0], boxl[0, 0, 1], boxl[0, 0, 2]
 
@@ -562,9 +577,21 @@ def group_pair_engine(
                 )
                 bits = jnp.sum(jnp.where(hit_rows, pow2, 0))
 
-            def chunk_math(c):
-                chunk = buf[slot, c]  # (nf_pad, 128)
-                j_fields = [chunk[f][None, :] for f in range(num_j)]
+            def chunk_math(t):
+                # one trip covers CW consecutive 128-lane chunks: the pair
+                # math runs on (G, 128*CW) tiles, amortizing the per-trip
+                # scalar/loop overhead over CW chunks
+                c = t * CW
+                parts = [buf[slot, c + k] for k in range(CW)]  # (nf_pad, 128)
+                if CW == 1:
+                    j_fields = [parts[0][f][None, :] for f in range(num_j)]
+                else:
+                    j_fields = [
+                        jnp.concatenate(
+                            [p[f][None, :] for p in parts], axis=1
+                        )
+                        for f in range(num_j)
+                    ]
                 if fold:
                     # tiny-grid path: shifts are all zero, fold per pair
                     jx, jy, jz = j_fields[0], j_fields[1], j_fields[2]
@@ -598,25 +625,36 @@ def group_pair_engine(
                 if want_nc:
                     ncacc_ref[...] = ncacc_ref[...] + mask.astype(jnp.int32)
 
-            def chunk_body(c, carry2):
+            def chunk_body(t, carry2):
                 if not chunk_skip:
-                    chunk_math(c)
+                    chunk_math(t)
                     return carry2
 
-                # the chunk's AABB verdict is bit c of the run's bitmask —
-                # skipping the whole (G, 128) tile's pair math for
+                # the trip's AABB verdict is CW bits of the run's bitmask —
+                # skipping the whole (G, 128*CW) tile's pair math for
                 # gap-bridged / overshoot chunks costs one scalar test
-                @pl.when((jax.lax.shift_right_logical(bits, c) & 1) != 0)
+                @pl.when(
+                    (jax.lax.shift_right_logical(bits, t * CW)
+                     & ((1 << CW) - 1)) != 0
+                )
                 def _():
-                    chunk_math(c)
+                    chunk_math(t)
 
                 return carry2
 
-            return jax.lax.fori_loop(0, nch, chunk_body, carry)
+            ntrip = (nch + CW - 1) // CW
+            return jax.lax.fori_loop(0, ntrip, chunk_body, carry)
 
+        if CW > 1:
+            # zero the pad rows the odd-tail paired read may touch:
+            # uninitialized VMEM can hold inf/NaN bit patterns, and bodies
+            # may multiply a mask-zeroed factor by raw geometry (0*inf=NaN)
+            for s_ in range(2):
+                for k_ in range(CW - 1):
+                    buf[s_, R + k_] = jnp.zeros((nf_pad, 128), jnp.float32)
         for r in acc_refs:
-            r[...] = jnp.zeros((G, 128), jnp.float32)
-        ncacc_ref[...] = jnp.zeros((G, 128), jnp.int32)
+            r[...] = jnp.zeros((G, LW), jnp.float32)
+        ncacc_ref[...] = jnp.zeros((G, LW), jnp.int32)
         jax.lax.fori_loop(0, nc_g, cell_body, 0)
         accs = tuple(r[...] for r in acc_refs)
 
@@ -698,11 +736,12 @@ def group_pair_engine(
             ]
             + [pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))],
             scratch_shapes=[
-                pltpu.VMEM((2, R, nf_pad, 128), jnp.float32),
+                # CW-1 pad rows absorb the paired read's odd-run tail
+                pltpu.VMEM((2, R + CW - 1, nf_pad, 128), jnp.float32),
                 pltpu.SemaphoreType.DMA((2,)),
             ]
-            + [pltpu.VMEM((G, 128), jnp.float32) for _ in range(num_acc)]
-            + [pltpu.VMEM((G, 128), jnp.int32)]
+            + [pltpu.VMEM((G, LW), jnp.float32) for _ in range(num_acc)]
+            + [pltpu.VMEM((G, LW), jnp.int32)]
             + (
                 [pltpu.VMEM((2, R, 128), jnp.float32),
                  pltpu.SemaphoreType.DMA((2,))]

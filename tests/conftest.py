@@ -25,6 +25,27 @@ import numpy as np
 import pytest
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run the slow tier (heavy CPU-mesh equivalence + e2e runs)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Default suite = fast tier (<5 min); the slow tier (heavy 8-device
+    equivalence runs, e2e shocks, hierarchical-MAC sweeps) runs with
+    --runslow or SPHEXA_ALL_TESTS=1 (VERDICT r3 #9 tier split). CI
+    recipe: both tiers' results are recorded in TESTS_r{N}.json."""
+    if config.getoption("--runslow") or os.environ.get("SPHEXA_ALL_TESTS"):
+        return
+    skip = pytest.mark.skip(reason="slow tier: pass --runslow (or "
+                            "SPHEXA_ALL_TESTS=1) to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -96,6 +96,7 @@ class TestL1:
 
 
 @pytest.mark.parametrize("case", ["sedov"])
+@pytest.mark.slow
 def test_sedov_e2e_l1(case):
     """Short Sedov run tracked against the analytic solution — the same
     comparison the reference CI asserts at -n 50 -s 200 (L1_rho = 0.138);

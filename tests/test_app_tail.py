@@ -241,6 +241,7 @@ def test_profile_substep_breakdown(tmp_path):
         assert "substep_momentum_energy" in subs
 
 
+@pytest.mark.slow
 def test_substep_breakdown_ve_pallas():
     from sphexa_tpu.init import init_sedov
     from sphexa_tpu.simulation import Simulation

@@ -158,6 +158,7 @@ class TestTreeVsDirect:
         assert np.isclose(float(egrav), -ms[0] * ms[1] / 10.0, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_hierarchical_mac_matches_dense():
     """The two-level superblock classification must reproduce the dense
     blocks-x-nodes sweep EXACTLY (super-accept implies block-accept, and
@@ -206,6 +207,7 @@ def test_hierarchical_mac_matches_dense():
     assert 0.0 < float(dh["mac_work_ratio"]) <= 1.0
 
 
+@pytest.mark.slow
 def test_hierarchical_mac_far_replica_root_accept():
     """A far replica shift makes the ROOT pass the MAC; the hierarchical
     downsweep must not let the root count as its own accepted ancestor

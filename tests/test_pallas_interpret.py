@@ -52,6 +52,7 @@ def test_density_matches_xla_interpret(case):
     np.testing.assert_allclose(np.asarray(rho1), np.asarray(rho0), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_matches_xla_interpret(case):
     ss, keys, box, const, nbr = case
     nidx, nmask, _, _ = find_neighbors(ss.x, ss.y, ss.z, ss.h, keys, box, nbr)
@@ -94,6 +95,7 @@ def test_pipeline_matches_xla_interpret(case):
 
 
 @pytest.mark.parametrize("av_clean", [False, True], ids=["plain", "avclean"])
+@pytest.mark.slow
 def test_ve_pipeline_matches_xla_interpret(case, av_clean):
     from sphexa_tpu.sph import hydro_ve
 

@@ -36,6 +36,7 @@ def _plummer_state(n=2000, seed=3):
     return state, box, const
 
 
+@pytest.mark.slow
 class TestNbodyPropagator:
     def test_runs_and_reports_egrav(self):
         state, box, const = _plummer_state()

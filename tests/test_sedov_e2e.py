@@ -23,6 +23,7 @@ def sedov_run():
     return sim, const, e0, e1, diags
 
 
+@pytest.mark.slow
 class TestSedovE2E:
     def test_runs_without_nans(self, sedov_run):
         sim, *_ = sedov_run

@@ -81,6 +81,7 @@ def test_m2p_autodiff_force_matches_fd():
     np.testing.assert_allclose(float(ax[0]), fd, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_order4_beats_quadrupole_in_gravity_solver():
     """End-to-end accuracy knob: Barnes-Hut forces at equal theta with
     spherical order-4 multipoles come closer to direct summation than

@@ -25,6 +25,7 @@ def ve_run():
     return sim, const, e0, e1, diags
 
 
+@pytest.mark.slow
 class TestVeE2E:
     def test_runs_without_nans(self, ve_run):
         sim, *_ = ve_run
@@ -69,6 +70,7 @@ def test_ve_avclean_runs():
     assert np.all(np.isfinite(np.asarray(sim.state.vx)))
 
 
+@pytest.mark.slow
 def test_ve_matches_std_on_uniform_gas():
     """On a uniform-density periodic gas with no perturbation, VE and std
     formulations reduce to the same physics: densities agree to O(1e-3)
