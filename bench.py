@@ -56,6 +56,70 @@ def _measure(sim, n, steps):
     return None
 
 
+def _gravity_scale_line(n=1_000_000):
+    """Gravity-only throughput at 1M (Plummer, theta=0.5, ~58k-node
+    tree): the scale where the dense MAC classification cost matters.
+    Standalone solve (no hydro) so the line isolates the tree walk the
+    reference benches as its nbody path."""
+    import dataclasses
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from sphexa_tpu.gravity.traversal import (
+        GravityConfig, compute_gravity, estimate_gravity_caps)
+    from sphexa_tpu.gravity.tree import build_gravity_tree
+    from sphexa_tpu.sfc.box import BoundaryType, Box
+    from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+    rng = np.random.default_rng(3)
+    u = rng.uniform(0.0, 1.0, n)
+    r = np.minimum(1.0 / np.sqrt(np.maximum(u ** (-2 / 3) - 1.0, 1e-12)),
+                   8.0)
+    cth = rng.uniform(-1.0, 1.0, n)
+    sth = np.sqrt(1.0 - cth * cth)
+    phi = rng.uniform(0.0, 2.0 * np.pi, n)
+    x = (r * sth * np.cos(phi)).astype(np.float32)
+    y = (r * sth * np.sin(phi)).astype(np.float32)
+    z = (r * cth).astype(np.float32)
+    m = np.full(n, 1.0 / n, np.float32)
+    ext = float(np.max(np.abs(np.stack([x, y, z])))) * 1.001
+    box = Box.create(-ext, ext, boundary=BoundaryType.open)
+    keys = np.asarray(compute_sfc_keys(jnp.asarray(x), jnp.asarray(y),
+                                       jnp.asarray(z), box))
+    order = np.argsort(keys)
+    xs, ys, zs, ms = (jnp.asarray(a[order]) for a in (x, y, z, m))
+    skeys = jnp.asarray(keys[order])
+    gtree, meta = build_gravity_tree(keys[order], bucket_size=64)
+    cfg = estimate_gravity_caps(
+        xs, ys, zs, ms, skeys, box, gtree, meta,
+        GravityConfig(theta=0.5, bucket_size=64, G=1.0, target_block=256,
+                      blocks_per_chunk=8,
+                      use_pallas=jax.default_backend() == "tpu"),
+        margin=1.6)
+    hs = jnp.full_like(xs, 1e-3)
+    args = (xs, ys, zs, ms, hs, skeys, box, gtree, meta)
+    out = compute_gravity(*args, cfg)
+    jax.block_until_ready(out)
+    out = compute_gravity(*args, cfg)  # discard post-compile outlier
+    jax.block_until_ready(out)
+    _ = float(out[3])
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(2):
+            out = compute_gravity(*args, cfg)
+        jax.block_until_ready(out)
+        _ = float(out[3])
+        best = min(best, (time.perf_counter() - t0) / 2)
+    return {
+        "gravity_1m_updates_per_sec": round(n / best, 1),
+        "gravity_1m_nodes": int(meta.num_nodes),
+        "gravity_1m_vs_baseline": round(
+            n / best / BASELINE_UPDATES_PER_SEC, 4),
+    }
+
+
 def main() -> int:
     from sphexa_tpu.init import init_evrard, init_sedov
     from sphexa_tpu.simulation import Simulation
@@ -98,6 +162,16 @@ def main() -> int:
             )
     except Exception as e:
         print(f"bench: VE+gravity line failed: {e}", file=sys.stderr)
+    try:
+        # gravity at >=1e6 particles (VERDICT r3 #4): the Barnes-Hut
+        # solve alone on a 1M Plummer sphere (the centrally-concentrated
+        # distribution that stresses the MAC), dense classification at
+        # the coarse target_block the Simulation picks at this N
+        gup = _gravity_scale_line()
+        if gup:
+            extra.update(gup)
+    except Exception as e:
+        print(f"bench: gravity-scale line failed: {e}", file=sys.stderr)
 
     # measured breakdowns/commentary live in docs/NEXT.md, labeled with the
     # hardware + commit they were taken on — repeating them here would

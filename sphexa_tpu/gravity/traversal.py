@@ -717,13 +717,13 @@ def compute_gravity(
     phi = phi.reshape(-1)[:n] * cfg.G
     # padded tail lanes duplicate the last particle; only [:n] is kept, and
     # egrav sums the trimmed arrays, so duplicates never double-count.
-    # evaluations actually performed, padded tail blocks included:
-    # dense = blocks x nodes; hierarchical = supers x nodes (pre-pass)
-    # + blocks x super_cap (refinement)
+    # evaluations over REAL blocks only, matching the phantom-masked
+    # numerator below: dense = blocks x nodes; hierarchical = supers x
+    # nodes (pre-pass) + blocks x super_cap (refinement)
     if sf > 0:
-        evals = nsc * chunk * num_n + m2p_n.size * scap
+        evals = num_super * num_n + num_blocks * scap
     else:
-        evals = m2p_n.size * num_n
+        evals = num_blocks * num_n
     # phantom tail blocks (chunk padding re-evaluates the last particle as
     # a point bbox) classify DIFFERENTLY from any real block — a point
     # target accepts more nodes than the block containing it — and their

@@ -105,6 +105,10 @@ def compute_momentum_energy_std(
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
         h_i = h[idx][:, None]
         h_j = h[g.nj]
+        if getattr(const, "sym_pairs", True):
+            # min-h symmetric cutoff: exact pairwise antisymmetry (see
+            # SimConstants.sym_pairs; matches the engine's sym_jf mask)
+            g = g._replace(mask=g.mask & (g.dist < 2.0 * h_j))
         w_i = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice) / (h_i * h_i * h_i)
         v2 = g.dist / h_j
         w_j = sinc_kernel_u(v2 * v2, const.sinc_index, const.kernel_choice) / (h_j * h_j * h_j)

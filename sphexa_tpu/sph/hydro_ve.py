@@ -239,6 +239,10 @@ def compute_momentum_energy_ve(
         g = pair_geometry(idx, x, y, z, h, nidx, nmask, box)
         h_i = h[idx][:, None]
         h_j = h[g.nj]
+        if getattr(const, "sym_pairs", True):
+            # min-h symmetric cutoff: exact pairwise antisymmetry (see
+            # SimConstants.sym_pairs; matches the engine's sym_jf mask)
+            g = g._replace(mask=g.mask & (g.dist < 2.0 * h_j))
         hi3 = h_i * h_i * h_i
         hj3 = h_j * h_j * h_j
         w_i = sinc_kernel_u(g.v1 * g.v1, const.sinc_index, const.kernel_choice) / hi3

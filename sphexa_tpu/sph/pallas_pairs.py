@@ -439,6 +439,7 @@ def group_pair_engine(
     pair_cutoff: bool = True,
     chunk_skip: Optional[bool] = None,
     want_nc: bool = True,
+    sym_jf: Optional[int] = None,
 ):
     """Build a pallas_call for one SPH pair op.
 
@@ -455,6 +456,11 @@ def group_pair_engine(
       (x, y, z are always fields 0-2 on both sides; h is i-field 3).
     - ``pair_cutoff``: include the d2 < (2 h_i)^2 support test in the
       pair mask (SPH); gravity's near field keeps every ranged pair.
+    - ``sym_jf``: j-field index of inv_h2j; when set the mask ALSO
+      requires d2 < (2 h_j)^2 — the min-h symmetric cutoff that makes
+      the momentum/energy pairing exactly antisymmetric (SimConstants
+      .sym_pairs rationale; a strict subset of the i-cutoff, so the
+      prologue's candidate coverage is unaffected).
     - ``chunk_skip``: cull whole 128-candidate chunks whose bbox misses
       the group's inflated bbox (defaults to ``pair_cutoff and not
       fold``); only meaningful for cutoff ops — gravity's near field has
@@ -613,6 +619,8 @@ def group_pair_engine(
                 mask = (cand >= s) & (cand < s + ln)
                 if pair_cutoff:
                     mask = mask & (d2 < h4)
+                if sym_jf is not None:
+                    mask = mask & (d2 * j_fields[sym_jf] < 4.0)
                 mask = mask & ((cand != tgt_idx) | (aself[0, 0, 0] != 0))
                 geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
                 # accumulators live in VMEM scratch (read-modify-write):
@@ -1011,6 +1019,7 @@ def pallas_momentum_energy_std(
     engine = group_pair_engine(
         pair_body, finalize, num_i=18, num_j=17, num_acc=5, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
+        sym_jf=3 if getattr(const, "sym_pairs", True) else None,
     )
     inv_h2 = 1.0 / (h * h)
     inv_h3 = inv_h2 / h
@@ -1459,6 +1468,7 @@ def pallas_momentum_energy_ve(
     engine = group_pair_engine(
         pair_body, finalize, num_i=NI, num_j=NJ, num_acc=6, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
+        sym_jf=3 if getattr(const, "sym_pairs", True) else None,
     )
     inv_h2 = 1.0 / (h * h)
     inv_h3 = inv_h2 / h

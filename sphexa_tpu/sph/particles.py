@@ -108,6 +108,18 @@ class SimConstants:
     eta_acc: float = 0.2
     max_dt_increase: float = 1.1
     sinc_index: float = 6.0
+    # symmetric (min-h) pair cutoff on the momentum/energy ops: the
+    # reference's gather search keeps pairs with 2h_j < d < 2h_i that j
+    # never sees, so j never feels the reaction terms — the resulting
+    # one-sided forces are the measured dt- and precision-INDEPENDENT
+    # energy drift at shocks (scripts/probe_du_precision.py: the f64
+    # closure Sum m(du + v.a) = -1.5e-5/step while f32-f64 differs by
+    # 1e-9). Masking momentum/energy pairs with d < 2*min(h_i, h_j)
+    # restores exact pairwise antisymmetry; the dropped half-pairs sit at
+    # the support edge where W_i vanishes, so the force change is tiny.
+    # (Deviation from momentum_energy_kern.hpp by design; Gadget-style
+    # symmetrization. False = reference-parity one-sided cutoff.)
+    sym_pairs: bool = True
     # kernel family (kernels.KERNEL_CHOICES; sph_kernel_tables.hpp:122-160)
     kernel_choice: str = "sinc"
     kernel_norm: Optional[float] = None  # filled by normalized()
