@@ -61,7 +61,6 @@ def _gravity_scale_line(n=1_000_000):
     tree): the scale where the dense MAC classification cost matters.
     Standalone solve (no hydro) so the line isolates the tree walk the
     reference benches as its nbody path."""
-    import dataclasses
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -69,20 +68,11 @@ def _gravity_scale_line(n=1_000_000):
     from sphexa_tpu.gravity.traversal import (
         GravityConfig, compute_gravity, estimate_gravity_caps)
     from sphexa_tpu.gravity.tree import build_gravity_tree
+    from sphexa_tpu.init.plummer import sample_plummer
     from sphexa_tpu.sfc.box import BoundaryType, Box
     from sphexa_tpu.sfc.keys import compute_sfc_keys
 
-    rng = np.random.default_rng(3)
-    u = rng.uniform(0.0, 1.0, n)
-    r = np.minimum(1.0 / np.sqrt(np.maximum(u ** (-2 / 3) - 1.0, 1e-12)),
-                   8.0)
-    cth = rng.uniform(-1.0, 1.0, n)
-    sth = np.sqrt(1.0 - cth * cth)
-    phi = rng.uniform(0.0, 2.0 * np.pi, n)
-    x = (r * sth * np.cos(phi)).astype(np.float32)
-    y = (r * sth * np.sin(phi)).astype(np.float32)
-    z = (r * cth).astype(np.float32)
-    m = np.full(n, 1.0 / n, np.float32)
+    x, y, z, m = sample_plummer(n)
     ext = float(np.max(np.abs(np.stack([x, y, z])))) * 1.001
     box = Box.create(-ext, ext, boundary=BoundaryType.open)
     keys = np.asarray(compute_sfc_keys(jnp.asarray(x), jnp.asarray(y),

@@ -7,7 +7,6 @@ with mac_work_ratio and end-to-end solve throughput reported.
 Usage: [N_PARTS=4000000] [THETA=0.5] python scripts/bench_gravity_scale.py
 """
 
-import dataclasses
 import os
 import sys
 import time
@@ -33,22 +32,7 @@ BUCKET = int(os.environ.get("BUCKET", "64"))
 SUPER = int(os.environ.get("SUPER", "8"))
 
 
-def plummer(n, a=1.0, rmax=8.0, seed=3):
-    """Standard Plummer-sphere sample, radius-clipped (the centrally
-    concentrated distribution the reference's Bonsai-style traversal is
-    built for — deep, strongly non-uniform trees)."""
-    rng = np.random.default_rng(seed)
-    u = rng.uniform(0.0, 1.0, n)
-    r = a / np.sqrt(np.maximum(u ** (-2.0 / 3.0) - 1.0, 1e-12))
-    r = np.minimum(r, rmax)
-    cth = rng.uniform(-1.0, 1.0, n)
-    sth = np.sqrt(1.0 - cth * cth)
-    phi = rng.uniform(0.0, 2.0 * np.pi, n)
-    x = (r * sth * np.cos(phi)).astype(np.float32)
-    y = (r * sth * np.sin(phi)).astype(np.float32)
-    z = (r * cth).astype(np.float32)
-    m = np.full(n, 1.0 / n, np.float32)
-    return x, y, z, m
+from sphexa_tpu.init.plummer import sample_plummer as plummer
 
 
 def time_solve(tag, args, cfg, iters=3):

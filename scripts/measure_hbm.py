@@ -1,0 +1,53 @@
+"""Peak-HBM audit of the VE step at increasing single-chip N
+(VERDICT r3 #8): measure device peak_bytes_in_use after a settled step,
+derive bytes/particle, and extrapolate to the 400^3 / 16-chip target
+(64M particles -> 4M/chip).
+
+Usage: [HBM_SIDES=100,126,159] python scripts/measure_hbm.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.simulation import Simulation
+
+SIDES = [int(s) for s in os.environ.get("HBM_SIDES", "100,126,159,200").split(",")]
+
+
+def peak_bytes():
+    st = jax.local_devices()[0].memory_stats() or {}
+    return st.get("peak_bytes_in_use", 0), st.get("bytes_in_use", 0)
+
+
+def main():
+    for side in SIDES:
+        n = side ** 3
+        try:
+            state, box, const = init_sedov(side)
+            sim = Simulation(state, box, const, prop="ve", block=8192,
+                             check_every=5)
+            for _ in range(5):
+                sim.step()
+            sim.flush()
+            jax.block_until_ready(sim.state.x)
+            peak, cur = peak_bytes()
+            print(f"side={side} n={n} peak={peak/2**30:.2f} GiB "
+                  f"({peak/n:.0f} B/particle) live={cur/2**30:.2f} GiB",
+                  flush=True)
+            del sim, state
+        except Exception as e:
+            print(f"side={side} n={n} FAILED: {type(e).__name__}: {e}"[:160],
+                  flush=True)
+            break
+    # extrapolation guide printed for BASELINE.md
+    print("target: 64M/16 chips = 4.0M particles/chip; v5e HBM = 16 GiB",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

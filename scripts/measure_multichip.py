@@ -1,0 +1,94 @@
+"""Multi-chip comm-volume measurements (VERDICT r3 #6): Wmax vs S as
+particles-per-shard grows, and bytes moved per exchange stage vs the
+round-2 full-array replication baseline.
+
+Size-based (no device timing): the windowed all_to_all moves
+(P-1) * Wmax rows per shard per stage; replication moved S * (P-1).
+
+Usage: JAX_PLATFORMS=cpu python scripts/measure_multichip.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.parallel.exchange import estimate_halo_window
+from sphexa_tpu.propagator import _sort_by_keys
+from sphexa_tpu.sfc.box import make_global_box
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+from sphexa_tpu.simulation import Simulation, make_propagator_config
+
+
+def measure(side, P):
+    state, box, const = init_sedov(side)
+    sim = Simulation(state, box, const, prop="std", block=8192)
+    sim.step()
+    state, box = sim.state, sim.box
+    box = make_global_box(state.x, state.y, state.z, box)
+    state, keys, _ = _sort_by_keys(state, box, "hilbert")
+    cfg = make_propagator_config(state, box, const, block=8192,
+                                 backend="pallas")
+    n = state.n
+    S = -(-n // P)
+    wmax = estimate_halo_window(state.x, state.y, state.z, state.h, keys,
+                                box, cfg.nbr, P=P)
+    # TRUE sparse halo need: distinct remote rows each dest requires
+    # (what a per-cell halo exchange — the reference's exchangeHalos —
+    # would move), vs the contiguous span the windowed design ships
+    from sphexa_tpu.sph.pallas_pairs import group_cell_ranges
+
+    ranges = group_cell_ranges(state.x, state.y, state.z, state.h, keys,
+                               box, cfg.nbr)
+    starts = np.asarray(ranges.starts)
+    lens = np.asarray(ranges.lens)
+    g = cfg.nbr.group
+    ng = starts.shape[0]
+    S = -(-n // P)
+    sparse = []
+    for dest in range(P):
+        g0, g1 = dest * S // g, min(((dest + 1) * S + g - 1) // g, ng)
+        need = np.zeros(n, bool)
+        for st, ln in zip(starts[g0:g1].ravel(), lens[g0:g1].ravel()):
+            if ln > 0:
+                need[st:st + ln] = True
+        need[dest * S:(dest + 1) * S] = False  # own slab rows are local
+        sparse.append(int(need.sum()))
+    sparse_mean = float(np.mean(sparse))
+    # bytes per shard per exchange stage: window rows x (P-1) peers x
+    # fields x 4B. The std step exchanges 3 stage-sets (coords+h+m for
+    # density: 4f; +vol for IAD: 4f; 17f for momentum); VE exchanges 6.
+    row_bytes = 4
+    win = (P - 1) * wmax
+    rep = (P - 1) * S
+    return dict(n=n, S=S, wmax=wmax, ratio=wmax / S,
+                win_rows=win, rep_rows=rep, saving=rep / max(win, 1),
+                sparse=sparse_mean, sparse_frac=sparse_mean / S)
+
+
+def main():
+    print(f"{'side':>5} {'n':>9} {'P':>3} {'S':>8} {'Wmax':>7} "
+          f"{'Wmax/S':>7} {'rows/stage':>11} {'vs repl':>8} "
+          f"{'sparse':>8} {'sparse/S':>8}")
+    for side, P in ((16, 8), (24, 8), (32, 8), (48, 8), (64, 8),
+                    (80, 8), (48, 2), (48, 4), (48, 16)):
+        try:
+            r = measure(side, P)
+            print(f"{side:>5} {r['n']:>9} {P:>3} {r['S']:>8} "
+                  f"{r['wmax']:>7} {r['ratio']:>7.3f} "
+                  f"{r['win_rows']:>11} {r['saving']:>7.2f}x "
+                  f"{r['sparse']:>8.0f} {r['sparse_frac']:>8.3f}",
+                  flush=True)
+        except Exception as e:
+            print(f"{side:>5} P={P} FAILED: {type(e).__name__}: {e}"[:140],
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
