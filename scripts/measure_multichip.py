@@ -50,7 +50,6 @@ def measure(side, P):
     lens = np.asarray(ranges.lens)
     g = cfg.nbr.group
     ng = starts.shape[0]
-    S = -(-n // P)
     sparse = []
     for dest in range(P):
         g0, g1 = dest * S // g, min(((dest + 1) * S + g - 1) // g, ng)

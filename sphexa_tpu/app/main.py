@@ -269,11 +269,18 @@ def main(argv=None) -> int:
         # a restart instead APPENDS new Step#n groups to the existing dump
         import glob as _glob
 
-        stale = (
-            _glob.glob(f"{args.out_dir}/dump_{case_tag}_it*.txt")
-            if args.ascii
-            else [dump_path] * (os.path.exists(dump_path) and not is_restart)
-        )
+        if args.ascii:
+            stale = _glob.glob(f"{args.out_dir}/dump_{case_tag}_it*.txt")
+        elif not is_restart:
+            # base file AND any sharded part files (a leftover part set
+            # from a previous run — possibly with a DIFFERENT device
+            # count — would be appended to / concatenated with new parts)
+            from sphexa_tpu.io.snapshot import _find_parts
+
+            stale = ([dump_path] if os.path.exists(dump_path) else [])
+            stale += _find_parts(dump_path)
+        else:
+            stale = []
         for f in stale:
             print(f"# removing stale {f}", file=sys.stderr)
             os.remove(f)
@@ -339,6 +346,7 @@ def main(argv=None) -> int:
             return
 
         from sphexa_tpu.io import write_snapshot
+        from sphexa_tpu.io.snapshot import write_snapshot_sharded
 
         if sim.turb_state is not None:
             from sphexa_tpu.sph.hydro_turb import turbulence_state_to_fields
@@ -351,7 +359,12 @@ def main(argv=None) -> int:
             from sphexa_tpu.physics.cooling import chemistry_to_fields
 
             extra = {**extra, **chemistry_to_fields(sim.chem)}
-        step = write_snapshot(
+        # on a mesh, dump file-per-shard (no global gather — the
+        # reference's parallel MPI-IO role); restart reads the base path
+        writer = (write_snapshot_sharded
+                  if getattr(sim, "_mesh", None) is not None
+                  else write_snapshot)
+        step = writer(
             dump_path, sim.state, sim.box, const, iteration=it,
             extra_fields=extra, case=case_name,
             case_settings=case_overrides,

@@ -32,9 +32,13 @@ def _is_int(s: str) -> bool:
 
 def looks_like_file(spec: str) -> bool:
     """Heuristic used by the init factory: a --init argument that names an
-    existing file (optionally with :step suffix) is a restart request."""
+    existing file (optionally with :step suffix) is a restart request.
+    A sharded dump's BASE path has no file of its own — only
+    .partKKKofPPP parts — and is equally a restart request."""
+    from sphexa_tpu.io.snapshot import _find_parts
+
     path, _ = parse_file_spec(spec)
-    return os.path.exists(path)
+    return os.path.exists(path) or bool(_find_parts(path))
 
 
 def init_from_file(

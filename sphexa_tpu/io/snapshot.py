@@ -123,8 +123,98 @@ def write_snapshot(
     return 0
 
 
+def _part_path(path: str, k: int, P: int) -> str:
+    base, ext = os.path.splitext(path)
+    return f"{base}.part{k:03d}of{P:03d}{ext}"
+
+
+def _find_parts(path: str) -> List[str]:
+    """Existing part files of a sharded snapshot base path (sorted)."""
+    import glob as _glob
+
+    base, ext = os.path.splitext(path)
+    return sorted(_glob.glob(f"{base}.part*of*{ext}"))
+
+
+def write_snapshot_sharded(
+    path: str,
+    state: ParticleState,
+    box: Box,
+    const: SimConstants,
+    iteration: int = 0,
+    extra_fields: Optional[Dict[str, np.ndarray]] = None,
+    case: str = "",
+    case_settings: Optional[Dict] = None,
+) -> int:
+    """Parallel snapshot: one part file per device shard, NO global
+    gather — the role of the reference's collective MPI-IO writer
+    (main/src/io/ifile_io_hdf5.cpp:49-314), transposed to the
+    file-per-shard pattern: every host writes only the slab rows its
+    devices own (on a multi-host mesh each process sees only its own
+    ``addressable_shards``), so dump bandwidth scales with hosts and the
+    64M-particle funnel through one writer disappears.
+
+    Part files are ordinary snapshots (same Step# layout) of their slab
+    rows; ``read_snapshot`` on the BASE path reassembles them. Returns
+    the step index written (parts stay step-aligned because every dump
+    writes all parts)."""
+    xarr = state.x
+    shards = getattr(xarr, "addressable_shards", None)
+    if not shards or len(getattr(xarr.sharding, "device_set", [])) <= 1:
+        # single-device state: plain snapshot (no parts)
+        return write_snapshot(path, state, box, const, iteration,
+                              extra_fields, case, case_settings)
+    P = len(xarr.sharding.device_set)
+    n = xarr.shape[0]
+    rows = n // P
+    # ONE host fetch per extra field (inside the shard loop each
+    # np.asarray would re-gather the full array P times)
+    extras_np = {k2: np.asarray(v) for k2, v in (extra_fields or {}).items()}
+    step = 0
+    for sh in shards:
+        sl = sh.index[0] if sh.index else slice(0, n)
+        start = sl.start or 0
+        k = start // rows
+
+        class _Part:
+            pass
+
+        part = _Part()
+        for f in CONSERVED_FIELDS:
+            a = getattr(state, f)
+            ash = a.addressable_shards[
+                [s.index[0].start or 0 for s in a.addressable_shards].index(
+                    start)
+            ]
+            setattr(part, f, np.asarray(ash.data))
+        part.n = rows
+        part.ttot = state.ttot
+        part.min_dt = state.min_dt
+        part.min_dt_m1 = state.min_dt_m1
+        ex = None
+        if extra_fields:
+            # per-particle extras are sliced to the part's rows;
+            # global tables (turbulence phases, chemistry scalars) go to
+            # part 0 ONLY (the reader takes part-0-only fields verbatim)
+            ex = {}
+            for k2, va in extras_np.items():
+                if va.ndim >= 1 and va.shape[0] == n:
+                    ex[k2] = va[start:start + rows]
+                elif k == 0:
+                    ex[k2] = va
+        step = write_snapshot(
+            _part_path(path, k, P), part, box, const, iteration, ex,
+            case, case_settings,
+        )
+    return step
+
+
 def list_steps(path: str) -> List[int]:
     """Step indices present in a snapshot file."""
+    if not os.path.exists(path):
+        parts = _find_parts(path)
+        if parts:
+            path = parts[0]
     if _is_h5(path):
         with h5py.File(path, "r") as f:
             return sorted(
@@ -152,6 +242,48 @@ def _h5_steps(f) -> List[int]:
 
 
 def _read_raw(path: str, step: int):
+    if not os.path.exists(path):
+        parts = _find_parts(path)
+        if parts:
+            # sharded snapshot: concatenate the slab-row parts in part
+            # order (file names carry the order); attrs from part 0.
+            # Guards: the part set must be complete (file names encode
+            # P), and every part must resolve to the SAME dump — a torn
+            # write (crash mid-dump) leaves later parts one step behind
+            import re
+
+            mP = re.search(r"part\d+of(\d+)", parts[0])
+            P_declared = int(mP.group(1)) if mP else len(parts)
+            if len(parts) != P_declared:
+                raise ValueError(
+                    f"{path}: sharded snapshot has {len(parts)} part files "
+                    f"but names declare {P_declared} shards (incomplete "
+                    "dump or mixed part sets from different runs)")
+            fields_all, attrs = None, None
+            for p in parts:
+                f, a = _read_raw_one(p, step)
+                if fields_all is None:
+                    fields_all, attrs = {k: [v] for k, v in f.items()}, a
+                else:
+                    if (int(a["iteration"]) != int(attrs["iteration"])
+                            or float(a["time"]) != float(attrs["time"])):
+                        raise ValueError(
+                            f"{p}: part resolves to iteration "
+                            f"{int(a['iteration'])} != part 0's "
+                            f"{int(attrs['iteration'])} — torn sharded "
+                            "dump (crash mid-write?); pass an explicit "
+                            "step index for the last complete dump")
+                    for k, v in f.items():
+                        fields_all.setdefault(k, []).append(v)
+            # fields present only in part 0 are global tables — verbatim;
+            # per-particle fields (present in every part) concatenate
+            out = {k: (np.concatenate(v) if len(v) == len(parts) else v[0])
+                   for k, v in fields_all.items()}
+            return out, attrs
+    return _read_raw_one(path, step)
+
+
+def _read_raw_one(path: str, step: int):
     if _is_h5(path):
         with h5py.File(path, "r") as f:
             idx = _resolve_step(_h5_steps(f), step, path)
@@ -169,6 +301,10 @@ def _read_raw(path: str, step: int):
 def read_step_attrs(path: str, step: int = -1) -> Dict[str, np.ndarray]:
     """Step attributes only (iteration, time, constants) — cheap restart
     metadata probe without loading the particle datasets."""
+    if not os.path.exists(path):
+        parts = _find_parts(path)
+        if parts:
+            path = parts[0]
     if _is_h5(path):
         with h5py.File(path, "r") as f:
             idx = _resolve_step(_h5_steps(f), step, path)
