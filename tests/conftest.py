@@ -49,3 +49,32 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def run_mesh_subprocess(code: str, timeout: int = 900):
+    """Run mesh test code in a FRESH process on a virtual 8-device CPU
+    mesh (shared scaffold: after many sharded programs compile in one
+    process, the oversubscribed XLA:CPU mesh can cross-route collective
+    executables — a harness artifact). ``code`` must print a sentinel;
+    callers assert on the returned CompletedProcess."""
+    import subprocess
+    import sys
+    import textwrap
+
+    preamble = textwrap.dedent("""
+        import os
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    """)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    return subprocess.run(
+        [sys.executable, "-c", preamble + textwrap.dedent(code)],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=timeout,
+    )

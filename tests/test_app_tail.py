@@ -256,3 +256,50 @@ def test_substep_breakdown_ve_pallas():
                 "eos", "iad", "divv_curlv", "av_switches",
                 "momentum_energy"):
         assert key in sub and sub[key] >= 0.0
+
+
+@pytest.mark.slow
+def test_sharded_dump_restart_cli(tmp_path):
+    """CLI round trip of the parallel file-per-shard snapshots: a mesh
+    run dumps P part files (no base file), a restart from the BASE path
+    reassembles them, CONTINUES the iteration count and appends new
+    part dumps; a fresh run into the same out_dir removes the stale
+    part set. Fresh subprocess via conftest.run_mesh_subprocess."""
+    from conftest import run_mesh_subprocess
+
+    out = str(tmp_path)
+    code = f"""
+        import glob, os
+        from sphexa_tpu.app.main import main as app_main
+        from sphexa_tpu.io.snapshot import read_step_attrs
+
+        out = {out!r}
+        rc = app_main(["--init", "sedov", "-n", "16", "-s", "2", "-w", "1",
+                       "-o", out, "--devices", "8", "--quiet"])
+        assert rc in (0, None), rc
+        base = f"{{out}}/dump_sedov.h5"
+        parts = sorted(glob.glob(f"{{out}}/dump_sedov.part*of*.h5"))
+        assert len(parts) == 8 and not os.path.exists(base), parts
+
+        # restart from the sharded BASE path: continues the iteration
+        # count and appends new part dumps (verified via the snapshot
+        # attrs, not just the exit code)
+        rc = app_main(["--init", base, "-s", "4", "-w", "1", "-o", out,
+                       "--devices", "8", "--quiet"])
+        assert rc in (0, None), rc
+        attrs = read_step_attrs(base, step=-1)
+        assert int(attrs["iteration"]) == 4, attrs["iteration"]
+
+        # a FRESH (non-restart) run must clear the stale part set first
+        rc = app_main(["--init", "sedov", "-n", "16", "-s", "1", "-w", "1",
+                       "-o", out, "--devices", "8", "--quiet"])
+        assert rc in (0, None), rc
+        import h5py
+        with h5py.File(sorted(glob.glob(
+                f"{{out}}/dump_sedov.part*of*.h5"))[0], "r") as f:
+            # fresh run: exactly the new dumps, no appended old steps
+            assert len([k for k in f.keys() if k.startswith("Step#")]) <= 2
+        print("SHARDED-DUMP-OK")
+    """
+    r = run_mesh_subprocess(code)
+    assert "SHARDED-DUMP-OK" in r.stdout, r.stderr[-2000:]

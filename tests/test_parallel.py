@@ -601,20 +601,9 @@ class TestSimulationMesh:
         cross-route collective executables (buffer-count mismatch) — a
         test-harness artifact; a fresh process shows the real behavior
         (jax.clear_caches() does not clear the collective registry)."""
-        import os
-        import subprocess
-        import sys
-        import textwrap
+        from conftest import run_mesh_subprocess
 
-        code = textwrap.dedent("""
-            import os
-            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-            os.environ["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=8"
-            )
-            import jax
-            jax.config.update("jax_platforms", "cpu")
+        code = """
             import numpy as np
 
             from sphexa_tpu.init import init_sedov
@@ -639,14 +628,8 @@ class TestSimulationMesh:
             rows = sim.state.x.addressable_shards[0].data.shape[0]
             assert rows == state.n // 8
             print("SIM-MESH-OK")
-        """)
-        env = {k: v for k, v in os.environ.items()
-               if k != "PALLAS_AXON_POOL_IPS"}
-        out = subprocess.run(
-            [sys.executable, "-c", code], env=env, cwd=os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))),
-            capture_output=True, text=True, timeout=600,
-        )
+        """
+        out = run_mesh_subprocess(code, timeout=600)
         assert "SIM-MESH-OK" in out.stdout, out.stderr[-2000:]
 
     def test_simulation_num_devices_indivisible_rejected(self):
