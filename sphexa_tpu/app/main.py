@@ -8,6 +8,7 @@ reported, not silently ignored.
 """
 
 import argparse
+import dataclasses as _dc
 import os
 import sys
 import time
@@ -37,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gravity MAC accuracy parameter [0.5]")
     p.add_argument("--G", type=float, default=None, dest="grav_constant",
                    help="gravitational constant override (enables gravity)")
+    p.add_argument("--sym-pairs", default=None, choices=("on", "off"),
+                   dest="sym_pairs",
+                   help="momentum/energy pair-cutoff convention: on = min-h "
+                        "symmetric (default), off = reference-parity "
+                        "one-sided; overrides the snapshot's symPairs attr")
     p.add_argument("--glass", default=None,
                    help="glass template HDF5 file, tiled into every "
                         "lattice-based IC (init/utils.hpp glass blocks); "
@@ -183,12 +189,13 @@ def main(argv=None) -> int:
 
     if args.grav_constant is not None:
         # --G overrides the case's gravitational constant (sphexa.cpp --G)
-        import dataclasses as _dc
-
         const = _dc.replace(const, g=args.grav_constant)
+    if args.sym_pairs is not None:
+        # explicit pair-cutoff convention override: reference-parity
+        # comparisons and continuations of dumps that predate the
+        # symPairs snapshot attribute need this (README round-4 notes)
+        const = _dc.replace(const, sym_pairs=(args.sym_pairs == "on"))
     if args.kernel is not None or args.sinc_index is not None:
-        import dataclasses as _dc
-
         from sphexa_tpu.sph.kernels import KERNEL_CHOICES, kernel_norm_3d
 
         kind = args.kernel or const.kernel_choice

@@ -46,6 +46,10 @@ _CONST_ATTRS = {
     "at_min": "Atmin", "at_max": "Atmax", "g": "gravConstant",
     "eps": "eps", "eta_acc": "etaAcc", "max_dt_increase": "maxDtIncrease",
     "sinc_index": "sincIndex", "kernel_choice": "kernelChoice",
+    # pair-cutoff convention: restarts must reproduce the writing run's
+    # force convention (min-h symmetric vs reference one-sided) — a
+    # continuation that silently flips it changes energies mid-run
+    "sym_pairs": "symPairs",
 }
 
 
@@ -54,10 +58,16 @@ def _is_h5(path: str) -> bool:
 
 
 def _step_attrs(state: ParticleState, box: Box, const: SimConstants,
-                iteration: int) -> Dict[str, np.ndarray]:
+                iteration: int,
+                num_particles_global: Optional[int] = None
+                ) -> Dict[str, np.ndarray]:
     attrs = {
         "iteration": np.int64(iteration),
-        "numParticlesGlobal": np.int64(state.n),
+        # the H5Part convention (ifile_io_hdf5.cpp) records the GLOBAL
+        # count on every rank's output; sharded part files override this
+        # so external tools probing any single part see the true total
+        "numParticlesGlobal": np.int64(
+            state.n if num_particles_global is None else num_particles_global),
         "time": np.float64(state.ttot),
         "minDt": np.float64(state.min_dt),
         "minDt_m1": np.float64(state.min_dt_m1),
@@ -82,6 +92,7 @@ def write_snapshot(
     extra_fields: Optional[Dict[str, np.ndarray]] = None,
     case: str = "",
     case_settings: Optional[Dict] = None,
+    num_particles_global: Optional[int] = None,
 ) -> int:
     """Append one restartable snapshot; returns the step index written.
 
@@ -90,11 +101,13 @@ def write_snapshot(
     ``case`` records the originating test-case name so a restarted run can
     re-select the matching observable (the reference records its init
     settings as file attributes for the same reason, settings.hpp:45-57).
+    ``num_particles_global`` overrides the numParticlesGlobal attribute
+    (sharded part files record the global count, not their row count).
     """
     fields = {f: np.asarray(getattr(state, f)) for f in CONSERVED_FIELDS}
     if extra_fields:
         fields.update({k: np.asarray(v) for k, v in extra_fields.items()})
-    attrs = _step_attrs(state, box, const, iteration)
+    attrs = _step_attrs(state, box, const, iteration, num_particles_global)
     if case:
         attrs["initCase"] = np.bytes_(case)
     if case_settings:
@@ -166,6 +179,10 @@ def write_snapshot_sharded(
                               extra_fields, case, case_settings)
     P = len(xarr.sharding.device_set)
     n = xarr.shape[0]
+    if n % P != 0:
+        raise ValueError(
+            f"sharded snapshot requires n divisible by the device count "
+            f"(n={n}, P={P}); the CLI trims ICs to a multiple of P")
     rows = n // P
     # ONE host fetch per extra field (inside the shard loop each
     # np.asarray would re-gather the full array P times)
@@ -182,10 +199,18 @@ def write_snapshot_sharded(
         part = _Part()
         for f in CONSERVED_FIELDS:
             a = getattr(state, f)
-            ash = a.addressable_shards[
-                [s.index[0].start or 0 for s in a.addressable_shards].index(
-                    start)
-            ]
+            starts = [s.index[0].start or 0 for s in a.addressable_shards]
+            if start not in starts:
+                raise ValueError(
+                    f"field {f}: no shard starting at row {start} "
+                    f"(shard starts {sorted(starts)}) — uneven or "
+                    "mismatched sharding across fields")
+            ash = a.addressable_shards[starts.index(start)]
+            if ash.data.shape[0] != rows:
+                raise ValueError(
+                    f"field {f}: shard at row {start} has "
+                    f"{ash.data.shape[0]} rows, expected {rows} — "
+                    "sharded snapshots require equal-size shards")
             setattr(part, f, np.asarray(ash.data))
         part.n = rows
         part.ttot = state.ttot
@@ -204,17 +229,25 @@ def write_snapshot_sharded(
                     ex[k2] = va
         step = write_snapshot(
             _part_path(path, k, P), part, box, const, iteration, ex,
-            case, case_settings,
+            case, case_settings, num_particles_global=n,
         )
     return step
 
 
 def list_steps(path: str) -> List[int]:
-    """Step indices present in a snapshot file."""
+    """Step indices present in a snapshot file.
+
+    On a sharded base path this is the INTERSECTION across part files, so
+    a torn dump's extra part-0 step (which ``_read_raw`` would refuse to
+    assemble) is never reported as readable."""
     if not os.path.exists(path):
         parts = _find_parts(path)
         if parts:
-            path = parts[0]
+            common: Optional[set] = None
+            for p in parts:
+                s = set(list_steps(p))
+                common = s if common is None else (common & s)
+            return sorted(common or ())
     if _is_h5(path):
         with h5py.File(path, "r") as f:
             return sorted(
@@ -259,6 +292,10 @@ def _read_raw(path: str, step: int):
                     f"{path}: sharded snapshot has {len(parts)} part files "
                     f"but names declare {P_declared} shards (incomplete "
                     "dump or mixed part sets from different runs)")
+            # resolve the selector against the steps COMPLETE across all
+            # parts (a torn dump leaves part 0 a step ahead; -1 must mean
+            # the newest ASSEMBLABLE step, matching list_steps)
+            step = _resolve_step(list_steps(path), step, path)
             fields_all, attrs = None, None
             for p in parts:
                 f, a = _read_raw_one(p, step)
@@ -304,7 +341,11 @@ def read_step_attrs(path: str, step: int = -1) -> Dict[str, np.ndarray]:
     if not os.path.exists(path):
         parts = _find_parts(path)
         if parts:
-            path = parts[0]
+            # resolve the selector against the steps COMPLETE across all
+            # parts (matching what _read_raw will accept), then probe
+            # part 0's attrs for that step
+            idx = _resolve_step(list_steps(path), step, path)
+            step, path = idx, parts[0]
     if _is_h5(path):
         with h5py.File(path, "r") as f:
             idx = _resolve_step(_h5_steps(f), step, path)
@@ -344,6 +385,8 @@ def read_snapshot_full(
                 v = attrs[name]
                 v = v.item() if hasattr(v, "item") else v
                 const_kw[field] = v.decode() if isinstance(v, bytes) else str(v)
+            elif field == "sym_pairs":
+                const_kw[field] = bool(int(float(attrs[name])))
             else:
                 cast = int if field in ("ng0", "ngmax") else float
                 const_kw[field] = cast(attrs[name])

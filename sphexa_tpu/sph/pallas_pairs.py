@@ -61,10 +61,18 @@ GROUP = 128  # default targets per group (NeighborConfig.group overrides)
 # tiles (two 128-lane chunks). MEASURED SLOWER on v5e (467 vs 410 ms for
 # the std Sedov 100^3 pipeline): the per-field lane concats cost more than
 # the halved loop overhead saves — the per-chunk overhead is accumulator
-# read-modify-write + field loads, which pairing cannot reduce. Kept as an
-# env knob for future hardware; default 1. (docs/NEXT.md round-4 notes.)
+# read-modify-write + field loads, which pairing cannot reduce. Kept for
+# future hardware; configured via NeighborConfig.chunk_pair (0 = take the
+# SPHEXA_CHUNK_PAIR env default, read at engine build so late env changes
+# take effect). (docs/NEXT.md round-4 notes.)
 import os as _os
-CHUNK_PAIR = int(_os.environ.get("SPHEXA_CHUNK_PAIR", "1"))
+
+
+def _chunk_pair(cfg) -> int:
+    cp = getattr(cfg, "chunk_pair", 0)
+    if not cp:
+        cp = int(_os.environ.get("SPHEXA_CHUNK_PAIR", "1"))
+    return max(1, cp)
 
 
 class PairGeom(NamedTuple):
@@ -475,7 +483,7 @@ def group_pair_engine(
     """
     R = _dma_rows(cfg.dma_cap)
     nf_pad = _round_up(num_j, 8)
-    CW = max(1, CHUNK_PAIR)  # chunks per inner-loop trip
+    CW = _chunk_pair(cfg)  # chunks per inner-loop trip
     LW = 128 * CW            # lane width of the pair-math tiles
     if chunk_skip is None:
         # bitmask bits live in one int32, so the DMA window must fit 31
@@ -581,7 +589,12 @@ def group_pair_engine(
                     jnp.int32(1),
                     jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0),
                 )
-                bits = jnp.sum(jnp.where(hit_rows, pow2, 0))
+                # AABB rows beyond the run's nch describe the NEXT run's
+                # rows — mask them so a paired trip (CW > 1) whose tail
+                # chunk is past the run never fires on a stale verdict
+                in_run = jax.lax.broadcasted_iota(
+                    jnp.int32, (R, 1), 0) < nch
+                bits = jnp.sum(jnp.where(hit_rows & in_run, pow2, 0))
 
             def chunk_math(t):
                 # one trip covers CW consecutive 128-lane chunks: the pair
