@@ -1,0 +1,118 @@
+"""Measure the list-walk engine vs the streaming engine per op on real
+TPU hardware (Sedov 100^3 by default) plus the list-build cost.
+
+Timing follows the axon rules from docs/NEXT.md: chain a data dependency
+across repeats and discard the first post-compile batch.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.propagator import _sort_by_keys
+from sphexa_tpu.simulation import make_propagator_config
+from sphexa_tpu.sph import pallas_pairs as pp
+from sphexa_tpu.sph.hydro_std import compute_eos_std
+from sphexa_tpu.sph.pair_lists import build_pair_lists, estimate_slot_cap
+
+
+def _barrier(out):
+    """axon: block_until_ready can return before device completion; a
+    DEPENDENT scalar fetch is the reliable barrier (docs/NEXT.md)."""
+    leaf = jax.tree.leaves(out)[0]
+    float(jnp.sum(leaf.astype(jnp.float32) if leaf.dtype != jnp.float32
+                  else leaf))
+
+
+def timed(fn, *args, reps=10, **kw):
+    out = fn(*args, **kw)           # compile
+    _barrier(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+    _barrier(out)
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=100)
+    ap.add_argument("--skin-rel", type=float, default=0.2,
+                    help="skin as a fraction of 2*h_max")
+    args = ap.parse_args()
+
+    state, box, const = init_sedov(args.n)
+    cfg = make_propagator_config(state, box, const, backend="pallas")
+    nbr = cfg.nbr
+    print(f"N={state.n}  level={nbr.level} cap={nbr.cap} "
+          f"window={nbr.window} run_cap={nbr.run_cap}")
+    ss, keys, _ = _sort_by_keys(state, box, "hilbert")
+    x, y, z, h, m = ss.x, ss.y, ss.z, ss.h, ss.m
+
+    h_max = float(jnp.max(h))
+    skin = args.skin_rel * 2.0 * h_max
+    scap = estimate_slot_cap(x, y, z, h, keys, box, nbr, skin)
+    print(f"skin={skin:.5f} ({args.skin_rel} x 2h_max)  slot_cap={scap}")
+
+    build = jax.jit(lambda *a: build_pair_lists(*a, box, nbr, skin, scap))
+    t_build, lists = timed(build, x, y, z, h, keys)
+    assert int(lists.overflow) == 0
+    lanes = float(lists.lanes_total) / state.n
+    print(f"list build: {t_build*1e3:7.1f} ms   lanes/target={lanes:.0f}")
+
+    t_rng, ranges = timed(
+        jax.jit(lambda *a: pp.group_cell_ranges(*a, box, nbr)),
+        x, y, z, h, keys)
+    print(f"prologue  : {t_rng*1e3:7.1f} ms")
+
+    # ---- density
+    f_s = jax.jit(lambda rng, *a: pp.pallas_density(*a, box, const, nbr,
+                                                    ranges=rng))
+    f_l = jax.jit(lambda ls, *a: pp.pallas_density(*a, box, const, nbr,
+                                                   lists=ls))
+    t0, (rho0, nc0, _) = timed(f_s, ranges, x, y, z, h, m, keys)
+    t1, (rho1, nc1, _) = timed(f_l, lists, x, y, z, h, m, None)
+    ok = np.array_equal(np.asarray(nc0), np.asarray(nc1))
+    dr = float(jnp.max(jnp.abs(rho0 - rho1) / rho0))
+    print(f"density   : stream {t0*1e3:7.1f} ms  lists {t1*1e3:7.1f} ms  "
+          f"x{t0/t1:.2f}  nc_eq={ok} drho={dr:.2e}")
+    rho = rho0
+
+    # ---- IAD
+    p, c = compute_eos_std(ss.temp, rho, const)
+    vol = m / rho
+    f_s = jax.jit(lambda rng, *a: pp.pallas_iad(*a, box, const, nbr,
+                                                ranges=rng))
+    f_l = jax.jit(lambda ls, *a: pp.pallas_iad(*a, box, const, nbr,
+                                               lists=ls))
+    t0, (cs0, _) = timed(f_s, ranges, x, y, z, h, vol, keys)
+    t1, (cs1, _) = timed(f_l, lists, x, y, z, h, vol, None)
+    sc = float(jnp.max(jnp.abs(cs0[0])))
+    dc = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(cs0, cs1)) / sc
+    print(f"iad       : stream {t0*1e3:7.1f} ms  lists {t1*1e3:7.1f} ms  "
+          f"x{t0/t1:.2f}  dC={dc:.2e}")
+
+    # ---- momentum
+    margs = (x, y, z, ss.vx, ss.vy, ss.vz, h, m, rho, p, c, *cs0)
+    f_s = jax.jit(lambda rng, *a: pp.pallas_momentum_energy_std(
+        *a, keys, box, const, nbr, ranges=rng))
+    f_l = jax.jit(lambda ls, *a: pp.pallas_momentum_energy_std(
+        *a, None, box, const, nbr, lists=ls))
+    t0, o0 = timed(f_s, ranges, *margs)
+    t1, o1 = timed(f_l, lists, *margs)
+    sc = float(jnp.max(jnp.abs(o0[0])))
+    da = float(jnp.max(jnp.abs(o0[0] - o1[0]))) / sc
+    print(f"momentum  : stream {t0*1e3:7.1f} ms  lists {t1*1e3:7.1f} ms  "
+          f"x{t0/t1:.2f}  dax={da:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -121,7 +121,7 @@ def engine_fold(box: Box, cfg: NeighborConfig) -> bool:
 
 def group_cell_ranges(
     x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig,
-    table=None,
+    table=None, radius_pad=0.0,
 ) -> GroupRanges:
     """Candidate cells of every group, culled and compacted.
 
@@ -160,7 +160,9 @@ def group_cell_ranges(
 
     lo = jnp.stack([xg.min(1), yg.min(1), zg.min(1)], axis=1)  # (NG, 3)
     hi = jnp.stack([xg.max(1), yg.max(1), zg.max(1)], axis=1)
-    radius = 2.0 * hg.max(1)  # (NG,)
+    # radius_pad: extra coverage slack (the list-build skin) so candidate
+    # runs stay valid while particles drift between list rebuilds
+    radius = 2.0 * hg.max(1) + radius_pad  # (NG,)
     box_lo = jnp.stack([box.lo[0], box.lo[1], box.lo[2]])
     base = jnp.floor((lo - radius[:, None] - box_lo) / edge).astype(jnp.int32)
     need = jnp.floor((hi + radius[:, None] - box_lo) / edge).astype(jnp.int32)
@@ -386,16 +388,19 @@ def _dma_rows(cap: int) -> int:
     return -(-(127 + cap) // 128)
 
 
-def pack_j_fields(fields: Sequence[jax.Array], cap: int) -> jax.Array:
+def pack_j_fields(fields: Sequence[jax.Array], cap: int,
+                  nf_min: int = 0) -> jax.Array:
     """Interleave the j-side fields into one (rows, nf_pad, 128) HBM
     buffer: slot j of field f lives at [j // 128, f, j % 128], so one
     dynamic row-slice DMA fetches EVERY field of a candidate cell.
     The tail is padded by a full DMA window so a range starting at the
     last particle still reads in-bounds garbage (masked); nf is padded
-    to the f32 sublane quantum."""
+    to the f32 sublane quantum. ``nf_min``: minimum field rows (the
+    list-walk engine stages one extra in-kernel row for the candidate's
+    global index)."""
     n = fields[0].shape[0]
     nf = len(fields)
-    nf_pad = _round_up(nf, 8)
+    nf_pad = _round_up(max(nf, nf_min), 8)
     rows = -(-n // 128) + _dma_rows(cap)
     flat = jnp.zeros((nf_pad, rows * 128), jnp.float32)
     flat = flat.at[:nf, :n].set(jnp.stack(fields))
@@ -448,6 +453,7 @@ def group_pair_engine(
     chunk_skip: Optional[bool] = None,
     want_nc: bool = True,
     sym_jf: Optional[int] = None,
+    skip_slots: int = 0,
 ):
     """Build a pallas_call for one SPH pair op.
 
@@ -476,6 +482,11 @@ def group_pair_engine(
     - ``want_nc``: accumulate per-target neighbor counts (the trailing
       output). Ops that ignore the counts pass False and save the
       count's read-modify-write in every chunk.
+    - ``skip_slots``: when > 0, the call takes a PairLists whose per-chunk
+      counts (sph/pair_lists.py mark bits) gate each chunk's math — the
+      AABB chunk-cull for free (no AABB table, no in-kernel bbox math),
+      available to every op while lists are valid. Requires CW == 1 and
+      excludes ``chunk_skip``.
     - returns fn(ranges, i_fields(NG,G) x num_i, j_packed, i_offset,
       allow_self) -> (outs (NG, G) x num_out, nc (NG, G)); ``allow_self``
       (traced bool) admits the self-index pair — replica-image passes of
@@ -485,6 +496,11 @@ def group_pair_engine(
     nf_pad = _round_up(num_j, 8)
     CW = _chunk_pair(cfg)  # chunks per inner-loop trip
     LW = 128 * CW            # lane width of the pair-math tiles
+    SKIP = skip_slots > 0
+    if SKIP:
+        if CW != 1:
+            raise ValueError("skip_slots requires chunk_pair == 1")
+        chunk_skip = False
     if chunk_skip is None:
         # bitmask bits live in one int32, so the DMA window must fit 31
         # chunks; beyond that (huge run_cap) the cull is simply skipped
@@ -497,10 +513,12 @@ def group_pair_engine(
 
     def kernel(*refs):
         starts, lens, shx_r, shy_r, shz_r, ncells, boxl, ioff, aself = refs[:9]
-        i_refs = refs[9 : 9 + num_i]
-        jref = refs[9 + num_i]
-        nj_in = 11 + num_i if chunk_skip else 10 + num_i
-        aabb_ref = refs[10 + num_i] if chunk_skip else None
+        base = 10 if SKIP else 9
+        cnt_r = refs[9] if SKIP else None
+        i_refs = refs[base : base + num_i]
+        jref = refs[base + num_i]
+        nj_in = base + 2 + num_i if chunk_skip else base + 1 + num_i
+        aabb_ref = refs[base + 1 + num_i] if chunk_skip else None
         out_refs = refs[nj_in : -2]
         nc_ref = refs[-2]
         (buf, sems, acc_refs, ncacc_ref, abuf, asems) = refs[-1]
@@ -647,6 +665,15 @@ def group_pair_engine(
                     ncacc_ref[...] = ncacc_ref[...] + mask.astype(jnp.int32)
 
             def chunk_body(t, carry2):
+                if SKIP:
+                    # persistent-list mark bits: a chunk with no lane in
+                    # the group's inflated bbox skips its math for one
+                    # SMEM test (the AABB cull with zero DMA cost)
+                    @pl.when(cnt_r[0, 0, carry2 + t] > 0)
+                    def _():
+                        chunk_math(t)
+
+                    return carry2
                 if not chunk_skip:
                     chunk_math(t)
                     return carry2
@@ -664,7 +691,8 @@ def group_pair_engine(
                 return carry2
 
             ntrip = (nch + CW - 1) // CW
-            return jax.lax.fori_loop(0, ntrip, chunk_body, carry)
+            slot_base = jax.lax.fori_loop(0, ntrip, chunk_body, carry)
+            return slot_base + nch if SKIP else slot_base
 
         if CW > 1:
             # zero the pad rows the odd-tail paired read may touch:
@@ -699,9 +727,11 @@ def group_pair_engine(
             kernel(*refs[:-ns], (buf, sems, acc_refs, refs[-1], None, None))
 
     def call(ranges: GroupRanges, i_fields: Sequence, j_packed,
-             i_offset=0, allow_self=False, aabb=None):
+             i_offset=0, allow_self=False, aabb=None, skip=None):
         if chunk_skip and aabb is None:
             raise ValueError("chunk_skip engine needs the chunk AABB table")
+        if SKIP and skip is None:
+            raise ValueError("skip_slots engine needs the PairLists")
         num_groups = ranges.num_groups
         # run-slot width comes from the ranges themselves: the sharded
         # path appends boundary-split slots beyond the window block
@@ -745,6 +775,7 @@ def group_pair_engine(
                 pl.BlockSpec((1, 1, 1), lambda g: (0, 0, 0),
                              memory_space=pltpu.SMEM),  # allow_self
             ]
+            + ([smem_spec((1, 1, skip_slots))] if SKIP else [])  # cnt
             + [
                 pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
                 for _ in range(num_i)
@@ -773,8 +804,277 @@ def group_pair_engine(
             jax.ShapeDtypeStruct((num_groups, 1, G), jnp.float32)
             for _ in range(num_out_arrays)
         ] + [jax.ShapeDtypeStruct((num_groups, 1, G), jnp.int32)]
-        args = (starts, lens, shx, shy, shz, ncells, boxl, ioff, aself,
-                *i_fields, j_packed) + ((aabb,) if chunk_skip else ())
+        args = (
+            (starts, lens, shx, shy, shz, ncells, boxl, ioff, aself)
+            + ((skip.cnt.reshape(num_groups, 1, skip_slots),)
+               if SKIP else ())
+            + (*i_fields, j_packed)
+            + ((aabb,) if chunk_skip else ())
+        )
+        outs = pl.pallas_call(
+            scalar_kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*args)
+        return outs
+
+    return call
+
+
+def group_pair_engine_lists(
+    pair_body: Callable,
+    finalize: Callable,
+    num_i: int,
+    num_j: int,
+    num_acc: int,
+    cfg: NeighborConfig,
+    interpret: bool = False,
+    pair_cutoff: bool = True,
+    want_nc: bool = True,
+    sym_jf: Optional[int] = None,
+):
+    """List-walk variant of ``group_pair_engine``: identical DMA-run
+    streaming, but every chunk's candidate lanes are COMPACTED with the
+    persistent lists' per-chunk gather indices (sph/pair_lists.py) and
+    merged into a dense 256-lane staging window; the pair math fires only
+    on FULL 128-lane staging chunks (plus one flush). Per-target lane
+    count drops from the streamed-chunk floor to the exact inflated-bbox
+    occupancy (~2.5x fewer VPU ops on the measured Sedov configs).
+
+    Contract differences from the streaming engine:
+    - call(lists, i_fields, j_packed, i_offset, allow_self) — runs come
+      from lists.ranges (build-time, skin-inflated);
+    - no fold mode (lists are disabled on tiny grids), no chunk pairing,
+      no AABB chunk-skip (the cnt>0 test replaces it at zero DMA cost);
+    - the candidate's GLOBAL sorted-array index is staged as an f32 row
+      (exact for n < 2^24; the HBM-headroom bound is 8M rows/chip), so
+      the self-pair and shard-offset tests read it from staging.
+    """
+    R = _dma_rows(cfg.dma_cap)
+    nf_pad = _round_up(num_j + 1, 8)  # +1: staged global-index row
+    IDXR = num_j                       # sublane row of the staged index
+
+    def kernel(*refs):
+        (starts, lens, shx_r, shy_r, shz_r, ncells, ioff, aself,
+         cnt_r, fill_r, emit_r, tail_r) = refs[:12]
+        i_refs = refs[12 : 12 + num_i]
+        jref = refs[12 + num_i]
+        gidx_ref = refs[13 + num_i]
+        out_refs = refs[14 + num_i : -2]
+        nc_ref = refs[-2]
+        (buf, sems, acc_refs, ncacc_ref, stage) = refs[-1]
+
+        gi = pl.program_id(0)
+        G = cfg.group
+        nc_g = ncells[0, 0, 0]
+
+        def dma(w, slot):
+            row_s = starts[0, 0, w] // 128
+            return pltpu.make_async_copy(
+                jref.at[pl.ds(row_s, R), :, :],
+                buf.at[slot], sems.at[slot],
+            )
+
+        @pl.when(nc_g > 0)
+        def _():
+            dma(0, 0).start()
+
+        i_fields = [r[0, 0][:, None] for r in i_refs]  # (G, 1) each
+        xi, yi, zi, hi = i_fields[:4]
+        tgt_f = (
+            ioff[0, 0, 0] + gi * G
+            + jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+        ).astype(jnp.float32)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+        lane_f = jax.lax.broadcasted_iota(jnp.int32, (nf_pad, 128), 1)
+        subl = jax.lax.broadcasted_iota(jnp.int32, (nf_pad, 128), 0)
+        h4 = 4.0 * hi * hi
+
+        def stage_math(valid):
+            st = stage[:, :128]  # (nf_pad, 128) value read
+            j_fields = [st[f][None, :] for f in range(num_j)]
+            cand_f = st[IDXR][None, :]
+            jx, jy, jz = j_fields[0], j_fields[1], j_fields[2]
+            rx = xi - jx
+            ry = yi - jy
+            rz = zi - jz
+            d2 = rx * rx + ry * ry + rz * rz
+            mask = jnp.broadcast_to(lane < valid, d2.shape)
+            if pair_cutoff:
+                mask = mask & (d2 < h4)
+            if sym_jf is not None:
+                mask = mask & (d2 * j_fields[sym_jf] < 4.0)
+            mask = mask & ((cand_f != tgt_f) | (aself[0, 0, 0] != 0))
+            geom = PairGeom(rx=rx, ry=ry, rz=rz, d2=d2, mask=mask)
+            accs = tuple(r[...] for r in acc_refs)
+            accs = pair_body(geom, i_fields, j_fields, accs)
+            for r, a in zip(acc_refs, accs):
+                r[...] = a
+            if want_nc:
+                ncacc_ref[...] = ncacc_ref[...] + mask.astype(jnp.int32)
+
+        def cell_body(w, slot_base):
+            slot = w % 2
+
+            @pl.when(w + 1 < nc_g)
+            def _():
+                dma(w + 1, 1 - slot).start()
+
+            dma(w, slot).wait()
+            s = starts[0, 0, w]
+            ln = lens[0, 0, w]
+            shx = shx_r[0, 0, w]
+            shy = shy_r[0, 0, w]
+            shz = shz_r[0, 0, w]
+            row0 = s // 128
+            off = s - row0 * 128
+            nch = (off + ln + 127) // 128
+
+            def chunk_body(t, _c):
+                si = slot_base + t
+                cnt = cnt_r[0, 0, si]
+                fill = fill_r[0, 0, si]
+
+                @pl.when(cnt > 0)
+                def _():
+                    # gidx arrives PRE-ROTATED by the staging fill, so
+                    # the compaction + rotation is ONE lane gather
+                    gi_row = gidx_ref[0, si][None, :]  # (1, 128) int32
+                    rolled = jnp.take_along_axis(
+                        buf[slot, t],
+                        jnp.broadcast_to(gi_row, (nf_pad, 128)), axis=1,
+                    )
+                    # image-resolve the coordinate rows and insert the
+                    # global-index row — one (nf_pad, 1) shift column +
+                    # one sublane select
+                    shift_col = jnp.where(
+                        subl[:, :1] == 0, shx,
+                        jnp.where(subl[:, :1] == 1, shy,
+                                  jnp.where(subl[:, :1] == 2, shz, 0.0)),
+                    )
+                    rolled = rolled + shift_col
+                    idx_f = ((row0 + t) * 128 + gi_row).astype(jnp.float32)
+                    rolled = jnp.where(
+                        subl == IDXR, jnp.broadcast_to(idx_f, rolled.shape),
+                        rolled,
+                    )
+                    m0 = (lane_f >= fill) & (lane_f < fill + cnt)
+                    m1 = lane_f < (fill + cnt - 128)
+                    stage[:, :128] = jnp.where(m0, rolled, stage[:, :128])
+                    stage[:, 128:] = jnp.where(m1, rolled, stage[:, 128:])
+
+                @pl.when(emit_r[0, 0, si] > 0)
+                def _():
+                    stage_math(jnp.int32(128))
+                    stage[:, :128] = stage[:, 128:]
+                    stage[:, 128:] = jnp.zeros((nf_pad, 128), jnp.float32)
+
+                return _c
+
+            jax.lax.fori_loop(0, nch, chunk_body, 0)
+            return slot_base + nch
+
+        stage[...] = jnp.zeros((nf_pad, 256), jnp.float32)
+        for r in acc_refs:
+            r[...] = jnp.zeros((G, 128), jnp.float32)
+        ncacc_ref[...] = jnp.zeros((G, 128), jnp.int32)
+        jax.lax.fori_loop(0, nc_g, cell_body, 0)
+
+        tail = tail_r[0, 0, 0]
+
+        @pl.when(tail > 0)
+        def _():
+            stage_math(tail)
+
+        accs = tuple(r[...] for r in acc_refs)
+        nc_acc = jnp.sum(ncacc_ref[...], axis=1, keepdims=True)
+        outs = finalize(i_fields, accs, nc_acc)
+        for r, o in zip(out_refs, outs):
+            r[0, 0] = o.reshape(G)
+        nc_ref[0, 0] = nc_acc.reshape(G)
+
+    def scalar_kernel(*refs):
+        ns = num_acc + 4  # buf, sems, accs x num_acc, ncacc, stage
+        buf, sems = refs[-ns], refs[-ns + 1]
+        acc_refs = refs[-ns + 2 : -2]
+        kernel(*refs[:-ns], (buf, sems, acc_refs, refs[-2], refs[-1]))
+
+    def call(lists, i_fields: Sequence, j_packed,
+             i_offset=0, allow_self=False):
+        ranges = lists.ranges
+        num_groups = ranges.num_groups
+        w3 = ranges.starts.shape[1]
+        S_cap = lists.slot_cap
+        ioff = jnp.asarray(i_offset, jnp.int32).reshape(1, 1, 1)
+        aself = jnp.asarray(allow_self, jnp.int32).reshape(1, 1, 1)
+        smem3 = lambda a: a.reshape(num_groups, 1, -1)
+        G = cfg.group
+        i_fields = [a.reshape(num_groups, 1, G) for a in i_fields]
+        num_out_arrays = len(
+            finalize(
+                [jnp.zeros((G, 1))] * num_i,
+                tuple(jnp.zeros((G, 1)) for _ in range(num_acc)),
+                jnp.zeros((G, 1), jnp.int32),
+            )
+        )
+        smem_spec = lambda shape: pl.BlockSpec(
+            shape, lambda g: (g, 0, 0), memory_space=pltpu.SMEM
+        )
+        rep_spec = lambda shape: pl.BlockSpec(
+            shape, lambda g: (0, 0, 0), memory_space=pltpu.SMEM
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(num_groups,),
+            in_specs=[
+                smem_spec((1, 1, w3)),     # starts
+                smem_spec((1, 1, w3)),     # lens
+                smem_spec((1, 1, w3)),     # shift x/y/z
+                smem_spec((1, 1, w3)),
+                smem_spec((1, 1, w3)),
+                smem_spec((1, 1, 1)),      # ncells
+                rep_spec((1, 1, 1)),       # i_offset
+                rep_spec((1, 1, 1)),       # allow_self
+                smem_spec((1, 1, S_cap)),  # cnt
+                smem_spec((1, 1, S_cap)),  # fill
+                smem_spec((1, 1, S_cap)),  # emit
+                smem_spec((1, 1, 1)),      # tail
+            ]
+            + [
+                pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
+                for _ in range(num_i)
+            ]
+            + [
+                pl.BlockSpec(memory_space=pl.ANY),             # j_packed
+                pl.BlockSpec((1, S_cap, 128), lambda g: (g, 0, 0)),  # gidx
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))
+                for _ in range(num_out_arrays)
+            ]
+            + [pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))],
+            scratch_shapes=[
+                pltpu.VMEM((2, R, nf_pad, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ]
+            + [pltpu.VMEM((G, 128), jnp.float32) for _ in range(num_acc)]
+            + [pltpu.VMEM((G, 128), jnp.int32)]
+            + [pltpu.VMEM((nf_pad, 256), jnp.float32)],
+        )
+        out_shape = [
+            jax.ShapeDtypeStruct((num_groups, 1, G), jnp.float32)
+            for _ in range(num_out_arrays)
+        ] + [jax.ShapeDtypeStruct((num_groups, 1, G), jnp.int32)]
+        args = (
+            smem3(ranges.starts), smem3(ranges.lens),
+            smem3(ranges.shift_x), smem3(ranges.shift_y),
+            smem3(ranges.shift_z),
+            ranges.ncells.reshape(num_groups, 1, 1), ioff, aself,
+            smem3(lists.cnt), smem3(lists.fill), smem3(lists.emit),
+            lists.tail.reshape(num_groups, 1, 1),
+            *i_fields, j_packed, lists.gidx,
+        )
         outs = pl.pallas_call(
             scalar_kernel,
             grid_spec=grid_spec,
@@ -818,6 +1118,7 @@ def _op_aabb(jfields: Sequence, box: Box, cfg: NeighborConfig):
 def pallas_density(
     x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
+    lists=None,
 ):
     """rho_i = K h_i^-3 (m_i + sum_j m_j W(|r_ij|/h_i)) + neighbor counts.
 
@@ -828,12 +1129,16 @@ def pallas_density(
     supplies the GLOBAL (all-gathered) candidate arrays (x, y, z, m) that
     ``sorted_keys``/``ranges`` index into, and ``i_offset`` is the slab's
     global start index (for the self-pair test).
+
+    ``lists``: persistent PairLists (sph/pair_lists.py) — the list-walk
+    engine replaces the streaming engine and ``sorted_keys``/``ranges``
+    are unused (candidate runs come from the build-time lists).
     """
     n = x.shape[0]
     coeffs = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
 
-    if ranges is None:
+    if ranges is None and lists is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
@@ -850,12 +1155,24 @@ def pallas_density(
         rho = K * (mi + rho_sum) / (hi * hi * hi)
         return (rho,)
 
+    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m), cfg.group)
+    jf = jdata or (x, y, z, m)
+    if lists is not None:
+        # cheap body: the mark-bit chunk skip beats in-kernel compaction
+        # (compaction's src-side take_along exceeds the ~10-op body)
+        engine = group_pair_engine(
+            pair_body, finalize, num_i=6, num_j=4, num_acc=1, cfg=cfg,
+            fold=False, interpret=interpret, chunk_skip=False,
+            skip_slots=lists.slot_cap,
+        )
+        jp = pack_j_fields(jf, cfg.dma_cap)
+        rho, nc = engine(lists.ranges, i_fields, jp, i_offset, skip=lists)
+        return rho.reshape(-1)[:n], nc.reshape(-1)[:n], \
+            lists.ranges.occupancy
     engine = group_pair_engine(
         pair_body, finalize, num_i=6, num_j=4, num_acc=1, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret, chunk_skip=False,
     )
-    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m), cfg.group)
-    jf = jdata or (x, y, z, m)
     jp = pack_j_fields(jf, cfg.dma_cap)
     rho, nc = engine(ranges, i_fields, jp, i_offset)
     return rho.reshape(-1)[:n], nc.reshape(-1)[:n], ranges.occupancy
@@ -864,6 +1181,7 @@ def pallas_density(
 def pallas_iad(
     x, y, z, h, vol, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
+    lists=None,
 ):
     """IAD tensor components (hydro_std.compute_iad, iad_kern.hpp) with the
     neighbor search fused in. ``vol`` is the per-particle volume estimate
@@ -877,7 +1195,7 @@ def pallas_iad(
     coeffs = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
 
-    if ranges is None:
+    if ranges is None and lists is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
     fold = engine_fold(box, cfg)
 
@@ -927,12 +1245,23 @@ def pallas_iad(
     # hook) measured SLOWER than the lane path on v5e (484 vs 434 ms/step,
     # Sedov 100^3): the per-chunk NT-dot relayout exceeds the ~20 VPU ops
     # it saves. Revisit if Mosaic grows a cheap lane-contraction.
+    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h),), cfg.group)
+    jf = jdata or (x, y, z, vol)
+    if lists is not None:
+        engine = group_pair_engine(
+            pair_body_lanes, finalize, num_i=5, num_j=4, num_acc=6,
+            cfg=cfg, fold=False, interpret=interpret, chunk_skip=False,
+            want_nc=False, skip_slots=lists.slot_cap,
+        )
+        jp = pack_j_fields(jf, cfg.dma_cap)
+        *cs, _nc = engine(lists.ranges, i_fields, jp, i_offset,
+                          skip=lists)
+        return tuple(c.reshape(-1)[:n] for c in cs), \
+            lists.ranges.occupancy
     engine = group_pair_engine(
         pair_body_lanes, finalize, num_i=5, num_j=4, num_acc=6, cfg=cfg,
         fold=fold, interpret=interpret, chunk_skip=False, want_nc=False,
     )
-    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h),), cfg.group)
-    jf = jdata or (x, y, z, vol)
     jp = pack_j_fields(jf, cfg.dma_cap)
     *cs, _nc = engine(ranges, i_fields, jp, i_offset)
     return tuple(c.reshape(-1)[:n] for c in cs), ranges.occupancy
@@ -943,6 +1272,7 @@ def pallas_momentum_energy_std(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
+    lists=None,
 ):
     """Pressure-gradient accelerations + energy rate + Courant dt
     (hydro_std.compute_momentum_energy_std, momentum_energy_kern.hpp:12-134)
@@ -958,7 +1288,7 @@ def pallas_momentum_energy_std(
     K = float(const.K)
     k_cour = float(const.k_cour)
 
-    if ranges is None:
+    if ranges is None and lists is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
@@ -1029,11 +1359,6 @@ def pallas_momentum_energy_std(
         dt_i = k_cour * hi / v
         return (K * red(momx), K * red(momy), K * red(momz), du, dt_i)
 
-    engine = group_pair_engine(
-        pair_body, finalize, num_i=18, num_j=17, num_acc=5, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
-        sym_jf=3 if getattr(const, "sym_pairs", True) else None,
-    )
     inv_h2 = 1.0 / (h * h)
     inv_h3 = inv_h2 / h
     i_fields = _prep_i(
@@ -1052,10 +1377,25 @@ def pallas_momentum_energy_std(
         jfields = (xj, yj, zj, 1.0 / (hj * hj), vxj, vyj, vzj, cj, mj,
                    mj / (rhoj * hj * hj * hj), pj / rhoj,
                    j11, j12, j13, j22, j23, j33)
+    sym = 3 if getattr(const, "sym_pairs", True) else None
+    f = lambda a: a.reshape(-1)[:n]
+    if lists is not None:
+        engine = group_pair_engine_lists(
+            pair_body, finalize, num_i=18, num_j=17, num_acc=5, cfg=cfg,
+            interpret=interpret, want_nc=False, sym_jf=sym,
+        )
+        jp = pack_j_fields(jfields, cfg.dma_cap, nf_min=18)
+        ax, ay, az, du, dt_i, _nc = engine(lists, i_fields, jp, i_offset)
+        return (f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)),
+                lists.ranges.occupancy)
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=18, num_j=17, num_acc=5, cfg=cfg,
+        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
+        sym_jf=sym,
+    )
     jp = pack_j_fields(jfields, cfg.dma_cap)
     ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp, i_offset,
                                        aabb=_op_aabb(jfields, box, cfg))
-    f = lambda a: a.reshape(-1)[:n]
     return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy
 
 
@@ -1072,6 +1412,7 @@ def pallas_momentum_energy_std(
 def pallas_xmass(
     x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
+    lists=None,
 ):
     """Generalized volume element xm_i = m_i / rho0_i (xmass_kern.hpp:50-79)
     + neighbor counts. rho0 is exactly the std kernel-summed density, so
@@ -1079,6 +1420,7 @@ def pallas_xmass(
     rho0, nc, occ = pallas_density(
         x, y, z, h, m, sorted_keys, box, const, cfg,
         ranges=ranges, interpret=interpret, jdata=jdata, i_offset=i_offset,
+        lists=lists,
     )
     return m / rho0, nc, occ
 
@@ -1086,6 +1428,7 @@ def pallas_xmass(
 def pallas_ve_def_gradh(
     x, y, z, h, m, xm, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
+    lists=None,
 ):
     """VE normalization kx + grad-h correction (ve_def_gradh_kern.hpp:43-90)
     with the search fused in. Returns ((kx, gradh), occupancy).
@@ -1098,7 +1441,7 @@ def pallas_ve_def_gradh(
     dc = kernel_dterh_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
 
-    if ranges is None:
+    if ranges is None and lists is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
@@ -1130,16 +1473,26 @@ def pallas_ve_def_gradh(
         gradh = 1.0 - dhdrho * whomega
         return (kx, gradh)
 
+    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m, xm), cfg.group)
+    jf = jdata or (x, y, z, m, xm)
+    f = lambda a: a.reshape(-1)[:n]
+    if lists is not None:
+        engine = group_pair_engine(
+            pair_body, finalize, num_i=7, num_j=5, num_acc=3, cfg=cfg,
+            fold=False, interpret=interpret, chunk_skip=False,
+            want_nc=False, skip_slots=lists.slot_cap,
+        )
+        jp = pack_j_fields(jf, cfg.dma_cap)
+        kx, gradh, _nc = engine(lists.ranges, i_fields, jp, i_offset,
+                                skip=lists)
+        return (f(kx), f(gradh)), lists.ranges.occupancy
     engine = group_pair_engine(
         pair_body, finalize, num_i=7, num_j=5, num_acc=3, cfg=cfg,
         fold=engine_fold(box, cfg), interpret=interpret, chunk_skip=False,
         want_nc=False,
     )
-    i_fields = _prep_i(x, y, z, h, (1.0 / (h * h), m, xm), cfg.group)
-    jf = jdata or (x, y, z, m, xm)
     jp = pack_j_fields(jf, cfg.dma_cap)
     kx, gradh, _nc = engine(ranges, i_fields, jp, i_offset)
-    f = lambda a: a.reshape(-1)[:n]
     return (f(kx), f(gradh)), ranges.occupancy
 
 
@@ -1148,7 +1501,7 @@ def pallas_iad_divv_curlv(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, with_gradv: bool = False, interpret: bool = False,
-    jdata=None, i_offset=0,
+    jdata=None, i_offset=0, lists=None,
 ):
     """Velocity divergence/curl through the IAD gradient
     (divv_curlv_kern.hpp:43-120), optionally the full symmetrized
@@ -1161,7 +1514,7 @@ def pallas_iad_divv_curlv(
     wc = kernel_poly_coeffs(float(const.sinc_index), const.kernel_choice)
     K = float(const.K)
 
-    if ranges is None:
+    if ranges is None and lists is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
@@ -1222,11 +1575,6 @@ def pallas_iad_divv_curlv(
         curlv = knorm * jnp.sqrt(acx * acx + acy * acy + acz * acz)
         return (divv, curlv)
 
-    engine = group_pair_engine(
-        pair_body, finalize, num_i=15, num_j=7,
-        num_acc=9 if with_gradv else 4, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
-    )
     knorm = K / (h * h * h * kx)
     i_fields = _prep_i(
         x, y, z, h,
@@ -1234,10 +1582,26 @@ def pallas_iad_divv_curlv(
         cfg.group,
     )
     jf = jdata or (x, y, z, xm, vx, vy, vz)
+    f = lambda a: a.reshape(-1)[:n]
+    if lists is not None:
+        engine = group_pair_engine(
+            pair_body, finalize, num_i=15, num_j=7,
+            num_acc=9 if with_gradv else 4, cfg=cfg,
+            fold=False, interpret=interpret, chunk_skip=False,
+            want_nc=False, skip_slots=lists.slot_cap,
+        )
+        jp = pack_j_fields(jf, cfg.dma_cap)
+        *outs, _nc = engine(lists.ranges, i_fields, jp, i_offset,
+                            skip=lists)
+        return tuple(f(a) for a in outs), lists.ranges.occupancy
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=15, num_j=7,
+        num_acc=9 if with_gradv else 4, cfg=cfg,
+        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
+    )
     jp = pack_j_fields(jf, cfg.dma_cap)
     *outs, _nc = engine(ranges, i_fields, jp, i_offset,
                         aabb=_op_aabb(jf, box, cfg))
-    f = lambda a: a.reshape(-1)[:n]
     return tuple(f(a) for a in outs), ranges.occupancy
 
 
@@ -1246,6 +1610,7 @@ def pallas_av_switches(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, dt, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
+    lists=None,
 ):
     """Per-particle viscosity switch evolution (av_switches_kern.hpp:43-137)
     with the search fused in. Returns (alpha_new (n,), occupancy).
@@ -1260,7 +1625,7 @@ def pallas_av_switches(
     alphamin = float(const.alphamin)
     decay_c = float(const.decay_constant)
 
-    if ranges is None:
+    if ranges is None and lists is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     def pair_body(geom, i_fields, j_fields, accs):
@@ -1313,10 +1678,6 @@ def pallas_av_switches(
         alpha_decayed = alpha_i + alphadot * dt_b
         return (jnp.where(alphaloc >= alpha_i, alphaloc, alpha_decayed),)
 
-    engine = group_pair_engine(
-        pair_body, finalize, num_i=19, num_j=9, num_acc=4, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
-    )
     # dt rides along as a constant i-field: one (1, 1, G) block DMA per
     # group (~256 B) — not worth a second engine scalar-operand mechanism
     dt_b = jnp.broadcast_to(jnp.asarray(dt, jnp.float32), x.shape)
@@ -1327,6 +1688,20 @@ def pallas_av_switches(
         cfg.group,
     )
     jf = jdata or (x, y, z, c, vx, vy, vz, xm / kx, divv)
+    if lists is not None:
+        engine = group_pair_engine(
+            pair_body, finalize, num_i=19, num_j=9, num_acc=4, cfg=cfg,
+            fold=False, interpret=interpret, chunk_skip=False,
+            want_nc=False, skip_slots=lists.slot_cap,
+        )
+        jp = pack_j_fields(jf, cfg.dma_cap)
+        alpha_new, _nc = engine(lists.ranges, i_fields, jp, i_offset,
+                                skip=lists)
+        return alpha_new.reshape(-1)[:n], lists.ranges.occupancy
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=19, num_j=9, num_acc=4, cfg=cfg,
+        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
+    )
     jp = pack_j_fields(jf, cfg.dma_cap)
     alpha_new, _nc = engine(ranges, i_fields, jp, i_offset,
                             aabb=_op_aabb(jf, box, cfg))
@@ -1338,7 +1713,7 @@ def pallas_momentum_energy_ve(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, const, cfg: NeighborConfig, nc=None,
     gradv=None, ranges=None, interpret: bool = False,
-    jdata=None, i_offset=0,
+    jdata=None, i_offset=0, lists=None,
 ):
     """VE momentum + energy (momentum_energy_kern.hpp:65-222) with the
     search fused in: Atwood-ramped crossed/uncrossed volume elements,
@@ -1362,7 +1737,7 @@ def pallas_momentum_energy_ve(
     ramp = float(const.ramp)
     av_clean = gradv is not None
 
-    if ranges is None:
+    if ranges is None and lists is None:
         ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg)
 
     NI = 23 + (7 if av_clean else 0)
@@ -1478,11 +1853,6 @@ def pallas_momentum_energy_ve(
         dt_i = k_cour * hi / v
         return (-K * red(momx), -K * red(momy), -K * red(momz), du, dt_i)
 
-    engine = group_pair_engine(
-        pair_body, finalize, num_i=NI, num_j=NJ, num_acc=6, cfg=cfg,
-        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
-        sym_jf=3 if getattr(const, "sym_pairs", True) else None,
-    )
     inv_h2 = 1.0 / (h * h)
     inv_h3 = inv_h2 / h
     rho = kx * m / xm
@@ -1512,8 +1882,23 @@ def pallas_momentum_energy_ve(
         if av_clean:
             jfields = jfields + list(gvj)
     i_fields = _prep_i(x, y, z, h, tuple(extra_i), cfg.group)
+    sym = 3 if getattr(const, "sym_pairs", True) else None
+    f = lambda a: a.reshape(-1)[:n]
+    if lists is not None:
+        engine = group_pair_engine_lists(
+            pair_body, finalize, num_i=NI, num_j=NJ, num_acc=6, cfg=cfg,
+            interpret=interpret, want_nc=False, sym_jf=sym,
+        )
+        jp = pack_j_fields(tuple(jfields), cfg.dma_cap, nf_min=NJ + 1)
+        ax, ay, az, du, dt_i, _nc = engine(lists, i_fields, jp, i_offset)
+        return (f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)),
+                lists.ranges.occupancy)
+    engine = group_pair_engine(
+        pair_body, finalize, num_i=NI, num_j=NJ, num_acc=6, cfg=cfg,
+        fold=engine_fold(box, cfg), interpret=interpret, want_nc=False,
+        sym_jf=sym,
+    )
     jp = pack_j_fields(tuple(jfields), cfg.dma_cap)
     ax, ay, az, du, dt_i, _nc = engine(ranges, i_fields, jp, i_offset,
                                        aabb=_op_aabb(jfields, box, cfg))
-    f = lambda a: a.reshape(-1)[:n]
     return f(ax), f(ay), f(az), f(du), jnp.min(f(dt_i)), ranges.occupancy
