@@ -76,6 +76,14 @@ class PropagatorConfig:
     # per-peer halo window rows (Wmax). 0 = full peer slabs (the safe
     # all_gather-equivalent); sized tighter by estimate_halo_window
     halo_window: int = 0
+    # persistent-neighbor-list mode (sph/pair_lists.py): > 0 enables it
+    # with this per-group chunk-slot budget; steady steps then skip the
+    # global sort AND the candidate prologue, momentum ops lane-compact,
+    # cheap ops chunk-skip. Sized at configure time like every cap.
+    list_slot_cap: int = 0
+    # Verlet skin as a fraction of the 2*h_max search radius: larger =
+    # fewer rebuilds but more candidate lanes per target
+    list_skin_rel: float = 0.2
 
 
 def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
@@ -113,6 +121,26 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
         return jax.tree.unflatten(treedef, leaves)
 
     return permute_tree(state), sorted_keys, permute_tree(aux)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def rebuild_pair_lists(state: ParticleState, box: Box,
+                       cfg: PropagatorConfig, aux=None):
+    """Persistent-list rebuild: box regrow + global SFC sort + list build
+    (sph/pair_lists.py). The returned state is the FROZEN sorted order
+    every steady step runs in until the next rebuild; ``aux`` (e.g.
+    ChemistryData) is permuted identically. The skin re-derives from the
+    current h_max, so it tracks the evolving resolution."""
+    from sphexa_tpu.sph.pair_lists import build_pair_lists
+
+    box = make_global_box(state.x, state.y, state.z, box)
+    state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
+    skin = jnp.float32(cfg.list_skin_rel) * 2.0 * jnp.max(state.h)
+    lists = build_pair_lists(
+        state.x, state.y, state.z, state.h, keys, box, cfg.nbr,
+        skin, cfg.list_slot_cap, interpret=_pallas_interpret(),
+    )
+    return state, box, lists, aux
 
 
 def _gravity_sharded_stage(state, box, cfg, gtree, keys):
@@ -432,18 +460,37 @@ def _ve_forces_sharded(state, box, cfg: PropagatorConfig, keys):
 
 def _std_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree], aux=None,
+    gtree: Optional[GravityTree], aux=None, lists=None,
 ):
     """The std-SPH force stage shared by the plain and cooling propagators
     (HydroProp::computeForces, std_hydro.hpp:123-157): box regrow -> sort ->
     neighbors -> density -> EOS -> IAD -> momentum/energy [-> gravity].
     ``aux`` is an optional per-particle pytree sorted along with the state
-    and returned last."""
+    and returned last.
+
+    ``lists``: persistent PairLists — the steady-step fast path: NO box
+    regrow, NO sort (the order is frozen at the last rebuild), NO
+    prologue; a ``list_ok`` diagnostic reports the Verlet-skin validity
+    of THIS step's input positions (an invalid step is discarded and
+    replayed by the driver, like a cap overflow)."""
     const = cfg.const
-    # grow open-boundary dims to fit drifted particles (box_mpi.hpp role);
-    # box limits are traced values, so this never recompiles
-    box = make_global_box(state.x, state.y, state.z, box)
-    state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
+    ldiag = None
+    if lists is not None:
+        from sphexa_tpu.sph.pair_lists import list_slack
+
+        if cfg.gravity is not None or cfg.shard_axis is not None:
+            raise NotImplementedError(
+                "persistent lists compose with single-device gravity-off "
+                "steps; gravity/sharded runs rebuild per step")
+        slack = list_slack(state.x, state.y, state.z, state.h, lists)
+        ldiag = {"list_slack": slack,
+                 "list_ok": (slack > 0.0).astype(jnp.int32)}
+        keys = None
+    else:
+        # grow open-boundary dims to fit drifted particles (box_mpi.hpp
+        # role); box limits are traced values, so this never recompiles
+        box = make_global_box(state.x, state.y, state.z, box)
+        state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 
     if cfg.backend == "pallas" and cfg.shard_axis is not None:
@@ -457,21 +504,25 @@ def _std_forces(
         from sphexa_tpu.sph import pallas_pairs as pp
 
         interp = _pallas_interpret()
-        ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
-        occ = ranges.occupancy
+        if lists is not None:
+            ranges = None
+            occ = lists.ranges.occupancy
+        else:
+            ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
+            occ = ranges.occupancy
         rho, nc, _ = pp.pallas_density(
             x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges,
-            interpret=interp,
+            interpret=interp, lists=lists,
         )
         p, c = hydro_std.compute_eos_std(state.temp, rho, const)
         (c11, c12, c13, c22, c23, c33), _ = pp.pallas_iad(
             x, y, z, h, m / rho, keys, box, const, cfg.nbr, ranges=ranges,
-            interpret=interp,
+            interpret=interp, lists=lists,
         )
         ax, ay, az, du, dt_courant, _ = pp.pallas_momentum_energy_std(
             x, y, z, state.vx, state.vy, state.vz, h, m, rho, p, c,
             c11, c12, c13, c22, c23, c33, keys, box, const, cfg.nbr,
-            ranges=ranges, interpret=interp,
+            ranges=ranges, interpret=interp, lists=lists,
         )
     else:
         nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
@@ -494,6 +545,8 @@ def _std_forces(
             state, box, keys, cfg, gtree, ax, ay, az
         )
         extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
+    if ldiag is not None:
+        gdiag = {**(gdiag or {}), **ldiag}
 
     return (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ,
             rho, c, gdiag, aux)
@@ -502,7 +555,7 @@ def _std_forces(
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def step_hydro_std(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree] = None,
+    gtree: Optional[GravityTree] = None, lists=None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
     """One standard-SPH time step (std_hydro.hpp:123-175 sequence).
 
@@ -510,7 +563,7 @@ def step_hydro_std(
     Returns (new_state, new_box, diagnostics).
     """
     (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
-     gdiag, _) = _std_forces(state, box, cfg, gtree)
+     gdiag, _) = _std_forces(state, box, cfg, gtree, lists=lists)
     dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=cfg.const)
     return _integrate_and_finish(
         state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
@@ -521,7 +574,7 @@ def step_hydro_std(
 @functools.partial(jax.jit, static_argnames=("cfg", "cool_cfg"))
 def step_hydro_std_cooling(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree], chem, cool_cfg,
+    gtree: Optional[GravityTree], chem, cool_cfg, lists=None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array], object]:
     """One std-SPH step with radiative cooling
     (HydroGrackleProp::step, std_hydro_grackle.hpp:193-233): force stage ->
@@ -535,7 +588,8 @@ def step_hydro_std_cooling(
 
     const = cfg.const
     (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
-     gdiag, chem) = _std_forces(state, box, cfg, gtree, aux=chem)
+     gdiag, chem) = _std_forces(state, box, cfg, gtree, aux=chem,
+                                lists=lists)
 
     u = const.cv * state.temp
     dt_cool = cooling_timestep(rho, u, chem, cool_cfg)
@@ -573,17 +627,31 @@ def _split_dvout(dvout, av_clean: bool):
 
 def _ve_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree],
+    gtree: Optional[GravityTree], lists=None,
 ):
     """The VE force stage shared by the plain and turbulence-stirred
     propagators (HydroVeProp::computeForces, ve_hydro.hpp:131-208):
     box regrow -> sort -> neighbors -> xmass -> ve_def_gradh -> EOS ->
     IAD -> divv/curlv -> AV switches -> momentum/energy [-> gravity].
     Returns the sorted state plus everything the step tail needs.
+    ``lists``: persistent-list steady-step fast path (see _std_forces).
     """
     const = cfg.const
-    box = make_global_box(state.x, state.y, state.z, box)
-    state, keys, _ = _sort_by_keys(state, box, cfg.curve)
+    ldiag = None
+    if lists is not None:
+        from sphexa_tpu.sph.pair_lists import list_slack
+
+        if cfg.gravity is not None or cfg.shard_axis is not None:
+            raise NotImplementedError(
+                "persistent lists compose with single-device gravity-off "
+                "steps; gravity/sharded runs rebuild per step")
+        slack = list_slack(state.x, state.y, state.z, state.h, lists)
+        ldiag = {"list_slack": slack,
+                 "list_ok": (slack > 0.0).astype(jnp.int32)}
+        keys = None
+    else:
+        box = make_global_box(state.x, state.y, state.z, box)
+        state, keys, _ = _sort_by_keys(state, box, cfg.curve)
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
     vx, vy, vz = state.vx, state.vy, state.vz
 
@@ -598,28 +666,32 @@ def _ve_forces(
         from sphexa_tpu.sph import pallas_pairs as pp
 
         interp = _pallas_interpret()
-        ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
-        occ = ranges.occupancy
+        if lists is not None:
+            ranges = None
+            occ = lists.ranges.occupancy
+        else:
+            ranges = pp.group_cell_ranges(x, y, z, h, keys, box, cfg.nbr)
+            occ = ranges.occupancy
         xm, nc, _ = pp.pallas_xmass(
             x, y, z, h, m, keys, box, const, cfg.nbr, ranges=ranges,
-            interpret=interp,
+            interpret=interp, lists=lists,
         )
         (kx, gradh), _ = pp.pallas_ve_def_gradh(
             x, y, z, h, m, xm, keys, box, const, cfg.nbr, ranges=ranges,
-            interpret=interp,
+            interpret=interp, lists=lists,
         )
         prho, c, rho, p = hydro_ve.compute_eos_ve(
             state.temp, m, kx, xm, gradh, const
         )
         (c11, c12, c13, c22, c23, c33), _ = pp.pallas_iad(
             x, y, z, h, xm / kx, keys, box, const, cfg.nbr, ranges=ranges,
-            interpret=interp,
+            interpret=interp, lists=lists,
         )
         dvout, _ = pp.pallas_iad_divv_curlv(
             x, y, z, vx, vy, vz, h, kx, xm,
             c11, c12, c13, c22, c23, c33,
             keys, box, const, cfg.nbr, ranges=ranges,
-            with_gradv=cfg.av_clean, interpret=interp,
+            with_gradv=cfg.av_clean, interpret=interp, lists=lists,
         )
         divv, curlv, gradv = _split_dvout(dvout, cfg.av_clean)
         dt_rho = rho_timestep(divv, const)
@@ -628,13 +700,13 @@ def _ve_forces(
             x, y, z, vx, vy, vz, h, c, kx, xm, divv, state.alpha,
             c11, c12, c13, c22, c23, c33,
             keys, box, state.min_dt, const, cfg.nbr, ranges=ranges,
-            interpret=interp,
+            interpret=interp, lists=lists,
         )
         ax, ay, az, du, dt_courant, _ = pp.pallas_momentum_energy_ve(
             x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
             c11, c12, c13, c22, c23, c33,
             keys, box, const, cfg.nbr, nc=nc, gradv=gradv, ranges=ranges,
-            interpret=interp,
+            interpret=interp, lists=lists,
         )
     else:
         nidx, nmask, nc, occ = find_neighbors(x, y, z, h, keys, box, cfg.nbr)
@@ -674,6 +746,8 @@ def _ve_forces(
             state, box, keys, cfg, gtree, ax, ay, az
         )
         extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
+    if ldiag is not None:
+        gdiag = {**(gdiag or {}), **ldiag}
 
     dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts, const=const)
     return state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag
@@ -682,7 +756,7 @@ def _ve_forces(
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def step_hydro_ve(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree] = None,
+    gtree: Optional[GravityTree] = None, lists=None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
     """One generalized-volume-element SPH time step.
 
@@ -692,7 +766,7 @@ def step_hydro_ve(
     communication the shardings imply.
     """
     (state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag) = _ve_forces(
-        state, box, cfg, gtree
+        state, box, cfg, gtree, lists=lists
     )
     return _integrate_and_finish(
         state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho,
@@ -704,7 +778,7 @@ def step_hydro_ve(
 @functools.partial(jax.jit, static_argnames=("cfg", "turb_cfg"))
 def step_turb_ve(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree], turb, turb_cfg,
+    gtree: Optional[GravityTree], turb, turb_cfg, lists=None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array], object]:
     """One stirred VE step (TurbVeProp::step, turb_ve.hpp:70-86): VE forces
     -> timestep -> OU-driven stirring accelerations -> positions ->
@@ -712,7 +786,7 @@ def step_turb_ve(
     from sphexa_tpu.sph.hydro_turb import drive_turbulence
 
     (state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag) = _ve_forces(
-        state, box, cfg, gtree
+        state, box, cfg, gtree, lists=lists
     )
     ax, ay, az, turb = drive_turbulence(
         state.x, state.y, state.z, ax, ay, az, dt, turb, turb_cfg

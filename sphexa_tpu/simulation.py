@@ -57,6 +57,9 @@ def make_propagator_config(
     gap: int = 384,
     group: int = 64,
     device_sizing: bool = False,
+    use_lists: bool = False,
+    list_skin_rel: float = 0.2,
+    list_slot_margin: float = 1.3,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -133,20 +136,51 @@ def make_propagator_config(
         ext = native.group_extents(xa, ya, za, order, group)
     # 10% radius slack absorbs drift between reconfigurations; a whole
     # margin cell costs ~2x window cells (every cell is a kernel iteration),
-    # and the window_ok guard reconfigures if the slack is ever outgrown
-    radius = 4.0 * h_max * 1.1
-    window = 1
-    for e, edge in zip(ext, lengths / ncell):
-        window = max(window, window_cells(e, radius, float(edge), ncell,
-                                          margin_cells=0))
-    nbr = NeighborConfig(
-        level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block,
-        curve=curve, group=group, window=window,
-        run_cap=run_cap, gap=gap,
-    )
+    # and the window_ok guard reconfigures if the slack is ever outgrown.
+    def size_window(radius):
+        w = 1
+        for e, edge in zip(ext, lengths / ncell):
+            w = max(w, window_cells(e, radius, float(edge), ncell,
+                                    margin_cells=0))
+        return w
+
+    def make_nbr(window):
+        return NeighborConfig(
+            level=level, cap=cap, ngmax=ngmax or const.ngmax, block=block,
+            curve=curve, group=group, window=window,
+            run_cap=run_cap, gap=gap,
+        )
+
+    nbr = make_nbr(size_window(4.0 * h_max * 1.1))
+    slot_cap = 0
+    skin = list_skin_rel * 2.0 * h_max
+    if use_lists and backend == "pallas" and not device_sizing:
+        from sphexa_tpu.sph.pair_lists import estimate_slot_cap
+        from sphexa_tpu.sph.pallas_pairs import engine_fold
+
+        # fold-mode eligibility is checked on the UNinflated window: the
+        # skin inflation only pays off when lists actually engage
+        if not engine_fold(box, nbr):
+            import jax.numpy as _jnp
+
+            # in list mode the window must additionally cover the skin
+            nbr = make_nbr(size_window((4.0 * h_max + skin) * 1.1))
+            if engine_fold(box, nbr):
+                nbr = make_nbr(size_window(4.0 * h_max * 1.1))
+            else:
+                # reuse the native sizing pass's keys/order (a second
+                # device keygen+argsort at 1M costs tens of ms per
+                # reconfigure for nothing)
+                skeys = _jnp.asarray(keys[order])
+                slot_cap = estimate_slot_cap(
+                    _jnp.asarray(xa[order]), _jnp.asarray(ya[order]),
+                    _jnp.asarray(za[order]), _jnp.asarray(h[order]),
+                    skeys, box, nbr, skin, margin=list_slot_margin,
+                )
     return PropagatorConfig(
         const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean,
         keep_accels=keep_accels, keep_fields=keep_fields, backend=backend,
+        list_slot_cap=slot_cap, list_skin_rel=list_skin_rel,
     )
 
 
@@ -177,6 +211,7 @@ class Simulation:
         chem=None,
         check_every: int = 1,
         num_devices: Optional[int] = None,
+        use_lists: bool = True,
     ):
         self.state = state
         self.box = box
@@ -270,6 +305,15 @@ class Simulation:
                 # per-particle chemistry rides the slab sharding like the
                 # state (std_hydro_grackle.hpp runs under the full domain)
                 self.chem = shard_state(self.chem, self._mesh)
+        # persistent neighbor lists (sph/pair_lists.py): steady steps skip
+        # the global sort + prologue and lane-compact the momentum ops;
+        # enabled on the single-device pallas path without gravity (the
+        # gravity tree rebuild needs fresh keys per step today). The
+        # eligibility re-derives at every _configure (fold mode depends
+        # on the sized grid).
+        self._want_lists = use_lists
+        self._lists = None
+        self._slot_margin = 1.3
         self.iteration = 0
         # deferred cap-checking (check_every > 1): the happy path launches
         # steps without any device->host sync; diagnostics of the last
@@ -287,7 +331,17 @@ class Simulation:
         self._configure()
 
     # -- static config management ------------------------------------------
+    @property
+    def _lists_eligible(self) -> bool:
+        return (
+            self._want_lists
+            and self._mesh is None
+            and not self.gravity_on
+            and self.prop_name != "nbody"
+        )
+
     def _configure(self, min_cap: int = 0, grav_margin: float = 1.5):
+        self._lists = None  # any static re-size invalidates the lists
         if self._mesh is not None:
             # drain in-flight steps before dispatching the sizing jits:
             # those jits contain their own collectives, and on CPU meshes
@@ -304,6 +358,8 @@ class Simulation:
             av_clean=self.av_clean, keep_accels=self.keep_accels,
             keep_fields=self.keep_fields, backend=self.backend,
             device_sizing=self._mesh is not None,
+            use_lists=self._lists_eligible,
+            list_slot_margin=self._slot_margin,
         )
         if self.gravity_on:
             self._configure_gravity(grav_margin)
@@ -439,6 +495,41 @@ class Simulation:
         cell_edge = float(np.min(np.asarray(self.box.lengths))) / (1 << nbr.level)
         return 2.0 * h_max <= cell_edge
 
+    @property
+    def _use_lists(self) -> bool:
+        # slot_cap == 0 also covers the fold-mode grids where lists are
+        # structurally unavailable (make_propagator_config leaves it 0)
+        return self._lists_eligible and self._cfg.list_slot_cap > 0
+
+    def _rebuild_lists(self):
+        """(Re)build the persistent lists: one jitted sort + mark pass.
+        Replaces the per-step rebuild the reference does
+        (find_neighbors.cuh) — between rebuilds the steady steps run on
+        the frozen order. A slot-cap overflow re-sizes the static budget
+        (recompile) and retries, like every other cap."""
+        import jax as _jax
+
+        from sphexa_tpu.propagator import rebuild_pair_lists
+
+        for _ in range(3):
+            if not self._use_lists:
+                # a reconfigure flipped the grid into fold mode or left
+                # list_slot_cap == 0: fall back to per-step streaming
+                # (self._lists stays None; steps run with lists=None)
+                return
+            aux = self.chem if self.prop_name == "std-cooling" else None
+            state, box, lists, aux = rebuild_pair_lists(
+                self.state, self.box, self._cfg, aux
+            )
+            if not int(_jax.device_get(lists.overflow)):
+                self.state, self.box, self._lists = state, box, lists
+                if aux is not None:
+                    self.chem = aux
+                return
+            self._slot_margin *= 1.5
+            self._configure()
+        raise RuntimeError("pair-list slot cap failed to converge")
+
     # -- main loop ----------------------------------------------------------
     def _drain(self, out):
         """CPU-mesh collective serialization: a program's scalar outputs
@@ -477,19 +568,24 @@ class Simulation:
             return new_state, new_box, diagnostics, None, None
         step_fn = _PROPAGATORS[self.prop_name]
         new_turb, new_chem = None, None
+        kw = {}
+        if self._use_lists:
+            if self._lists is None:
+                self._rebuild_lists()
+            kw["lists"] = self._lists
         if self.prop_name == "turb-ve":
             new_state, new_box, diagnostics, new_turb = step_fn(
                 self.state, self.box, self._cfg, self._gtree,
-                self.turb_state, self.turb_cfg,
+                self.turb_state, self.turb_cfg, **kw,
             )
         elif self.prop_name == "std-cooling":
             new_state, new_box, diagnostics, new_chem = step_fn(
                 self.state, self.box, self._cfg, self._gtree,
-                self.chem, self.cooling_cfg,
+                self.chem, self.cooling_cfg, **kw,
             )
         else:
             new_state, new_box, diagnostics = step_fn(
-                self.state, self.box, self._cfg, self._gtree
+                self.state, self.box, self._cfg, self._gtree, **kw
             )
         return new_state, new_box, diagnostics, new_turb, new_chem
 
@@ -519,7 +615,17 @@ class Simulation:
         return (
             int(diagnostics["occupancy"]) > self._cfg.nbr.cap
             or self._gravity_overflowed(diagnostics)
+            or not self._lists_fresh(diagnostics)
         )
+
+    @staticmethod
+    def _lists_fresh(diagnostics) -> bool:
+        """False when the step ran on EXPIRED lists (drift/growth ate
+        the Verlet skin before launch): its pair sums may have missed
+        neighbors, so the step must be discarded and replayed on fresh
+        lists — the same discard semantics as a cap overflow, but the
+        recovery is a cheap list rebuild, not a static re-size."""
+        return int(diagnostics.get("list_ok", 1)) != 0
 
     def _reconfigure_after_overflow(self, diagnostics, grav_margin: float):
         occ = int(diagnostics["occupancy"])
@@ -548,21 +654,32 @@ class Simulation:
         never corrupt state."""
         reconfigured = False
         grav_margin = 1.5
-        for _attempt in range(3):
+        for _attempt in range(4):
             out = self._launch()
             diagnostics = {**out[2], **self._fetch_scalars(out[2])}
             if not self._overflowed(diagnostics):
                 break
+            if not self._lists_fresh(diagnostics):
+                # stale persistent lists: discard + rebuild (no re-size)
+                self._rebuild_lists()
+                continue
             if self._gravity_overflowed(diagnostics):
                 grav_margin *= 1.5
             self._reconfigure_after_overflow(diagnostics, grav_margin)
             reconfigured = True
         else:
             raise RuntimeError(
-                "neighbor/gravity caps failed to converge in 3 attempts"
+                "neighbor/gravity caps failed to converge in 4 attempts"
             )
         self._apply(out)
         self.iteration += 1
+        if self._use_lists and (
+            float(diagnostics.get("list_slack", 1.0)) < 0.25
+        ):
+            # proactive rebuild while the lists are still VALID: the next
+            # step would likely expire mid-flight and be discarded —
+            # rebuilding now costs one sort+mark, not a wasted step
+            self._rebuild_lists()
         if not self._config_still_valid(diagnostics):
             self._configure()
             reconfigured = True
@@ -624,6 +741,12 @@ class Simulation:
             }
             result["reconfigured"] = 0.0
             self._last_diag = result
+            if self._use_lists and (
+                float(fetched[-1].get("list_slack", 1.0)) < 0.25
+            ):
+                # proactive rebuild at the check boundary so the next
+                # window doesn't expire mid-flight and need a rollback
+                self._rebuild_lists()
             if not self._config_still_valid(fetched[-1]):
                 self._configure()
                 self._last_diag["reconfigured"] = 1.0
@@ -632,8 +755,15 @@ class Simulation:
         diag_bad = fetched[bad]
         (self.state, self.box, self.turb_state, self.chem,
          self.iteration) = prior
-        grav_margin = 1.5 * (1.5 if self._gravity_overflowed(diag_bad) else 1.0)
-        self._reconfigure_after_overflow(diag_bad, grav_margin)
+        if (not self._lists_fresh(diag_bad)
+                and int(diag_bad["occupancy"]) <= self._cfg.nbr.cap
+                and not self._gravity_overflowed(diag_bad)):
+            # expiry only: fresh lists on the rolled-back state suffice
+            self._rebuild_lists()
+        else:
+            grav_margin = 1.5 * (
+                1.5 if self._gravity_overflowed(diag_bad) else 1.0)
+            self._reconfigure_after_overflow(diag_bad, grav_margin)
         for _ in range(len(pending)):
             result = self._step_checked()
         result["reconfigured"] = 1.0

@@ -75,21 +75,29 @@ class PairLists(NamedTuple):
         return self.gidx.shape[1]
 
 
-def lists_valid(x, y, z, h, lists: PairLists):
-    """Verlet-skin validity: the build-time candidate coverage (bbox
-    inflated by 2*h_build + skin) still covers every current 2h_i sphere
-    iff 2*(max h-growth + max drift) <= skin.
+def list_slack(x, y, z, h, lists: PairLists):
+    """Remaining skin fraction in [-inf, 1]: positive = the build-time
+    candidate coverage (bbox inflated by 2*h_build + skin) still covers
+    every current 2h_i sphere, which holds while
+    2*(max h-growth + max drift) <= skin.
 
     Drift is measured UNFOLDED: a particle wrapping the periodic box
     shows a ~L jump and correctly forces a rebuild (its build-time image
-    shift no longer resolves its pairs)."""
+    shift no longer resolves its pairs). The host watches the slack to
+    rebuild PROACTIVELY before a step would have to be discarded."""
     dx = x - lists.xb
     dy = y - lists.yb
     dz = z - lists.zb
     d2 = dx * dx + dy * dy + dz * dz
     drift = jnp.sqrt(jnp.max(d2))
     growth = jnp.maximum(jnp.max(h - lists.hb), 0.0)
-    return 2.0 * (growth + drift) <= lists.skin
+    used = 2.0 * (growth + drift)
+    return (lists.skin - used) / jnp.maximum(lists.skin, 1e-30)
+
+
+def lists_valid(x, y, z, h, lists: PairLists):
+    """Verlet-skin validity (see list_slack)."""
+    return list_slack(x, y, z, h, lists) > 0.0
 
 
 def _mark_kernel_builder(cfg: NeighborConfig, slot_cap: int,
@@ -293,17 +301,27 @@ def build_pair_lists(
     )
 
 
+import functools as _functools
+
+
+@_functools.partial(jax.jit, static_argnames=("cfg",))
+def _slot_need(x, y, z, h, sorted_keys, box, cfg, skin):
+    ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg,
+                               radius_pad=skin)
+    off = ranges.starts % 128
+    nch = jnp.where(ranges.lens > 0, (off + ranges.lens + 127) // 128, 0)
+    return jnp.max(jnp.sum(nch, axis=1))
+
+
 def estimate_slot_cap(
     x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig, skin: float,
     margin: float = 1.3, quantum: int = 8,
 ) -> int:
     """Host-side sizing of the static per-group chunk-slot budget from
-    the current distribution (configure-time, like cell caps)."""
+    the current (SFC-sorted) distribution — configure-time, like cell
+    caps; the build-time ``overflow`` sentinel guards outgrowth."""
     from sphexa_tpu.neighbors.cell_list import pad_cap
 
-    ranges = group_cell_ranges(x, y, z, h, sorted_keys, box, cfg,
-                               radius_pad=skin)
-    off = ranges.starts % 128
-    nch = jnp.where(ranges.lens > 0, (off + ranges.lens + 127) // 128, 0)
-    need = int(jnp.max(jnp.sum(nch, axis=1)))
+    need = int(_slot_need(x, y, z, h, sorted_keys, box, cfg,
+                          jnp.float32(skin)))
     return pad_cap(need, margin, quantum)
