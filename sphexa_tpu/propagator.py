@@ -458,6 +458,28 @@ def _ve_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     return out
 
 
+def _force_stage_prologue(state, box, cfg: PropagatorConfig, lists, aux=None):
+    """Shared head of the force stages: list mode (frozen order, validity
+    diagnostics) vs per-step box regrow + global sort. Returns
+    (state, box, keys, ldiag, aux); keys is None in list mode."""
+    if lists is not None:
+        from sphexa_tpu.sph.pair_lists import list_slack
+
+        if cfg.gravity is not None or cfg.shard_axis is not None:
+            raise NotImplementedError(
+                "persistent lists compose with single-device gravity-off "
+                "steps; gravity/sharded runs rebuild per step")
+        slack = list_slack(state.x, state.y, state.z, state.h, lists)
+        ldiag = {"list_slack": slack,
+                 "list_ok": (slack >= 0.0).astype(jnp.int32)}
+        return state, box, None, ldiag, aux
+    # grow open-boundary dims to fit drifted particles (box_mpi.hpp
+    # role); box limits are traced values, so this never recompiles
+    box = make_global_box(state.x, state.y, state.z, box)
+    state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
+    return state, box, keys, None, aux
+
+
 def _std_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree], aux=None, lists=None,
@@ -474,23 +496,9 @@ def _std_forces(
     of THIS step's input positions (an invalid step is discarded and
     replayed by the driver, like a cap overflow)."""
     const = cfg.const
-    ldiag = None
-    if lists is not None:
-        from sphexa_tpu.sph.pair_lists import list_slack
-
-        if cfg.gravity is not None or cfg.shard_axis is not None:
-            raise NotImplementedError(
-                "persistent lists compose with single-device gravity-off "
-                "steps; gravity/sharded runs rebuild per step")
-        slack = list_slack(state.x, state.y, state.z, state.h, lists)
-        ldiag = {"list_slack": slack,
-                 "list_ok": (slack > 0.0).astype(jnp.int32)}
-        keys = None
-    else:
-        # grow open-boundary dims to fit drifted particles (box_mpi.hpp
-        # role); box limits are traced values, so this never recompiles
-        box = make_global_box(state.x, state.y, state.z, box)
-        state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
+    state, box, keys, ldiag, aux = _force_stage_prologue(
+        state, box, cfg, lists, aux
+    )
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 
     if cfg.backend == "pallas" and cfg.shard_axis is not None:
@@ -637,21 +645,9 @@ def _ve_forces(
     ``lists``: persistent-list steady-step fast path (see _std_forces).
     """
     const = cfg.const
-    ldiag = None
-    if lists is not None:
-        from sphexa_tpu.sph.pair_lists import list_slack
-
-        if cfg.gravity is not None or cfg.shard_axis is not None:
-            raise NotImplementedError(
-                "persistent lists compose with single-device gravity-off "
-                "steps; gravity/sharded runs rebuild per step")
-        slack = list_slack(state.x, state.y, state.z, state.h, lists)
-        ldiag = {"list_slack": slack,
-                 "list_ok": (slack > 0.0).astype(jnp.int32)}
-        keys = None
-    else:
-        box = make_global_box(state.x, state.y, state.z, box)
-        state, keys, _ = _sort_by_keys(state, box, cfg.curve)
+    state, box, keys, ldiag, _ = _force_stage_prologue(
+        state, box, cfg, lists
+    )
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
     vx, vy, vz = state.vx, state.vy, state.vz
 

@@ -212,6 +212,7 @@ class Simulation:
         check_every: int = 1,
         num_devices: Optional[int] = None,
         use_lists: bool = True,
+        list_skin_rel: float = 0.2,
     ):
         self.state = state
         self.box = box
@@ -312,6 +313,7 @@ class Simulation:
         # eligibility re-derives at every _configure (fold mode depends
         # on the sized grid).
         self._want_lists = use_lists
+        self._list_skin_rel = list_skin_rel
         self._lists = None
         self._slot_margin = 1.3
         self.iteration = 0
@@ -359,6 +361,7 @@ class Simulation:
             keep_fields=self.keep_fields, backend=self.backend,
             device_sizing=self._mesh is not None,
             use_lists=self._lists_eligible,
+            list_skin_rel=self._list_skin_rel,
             list_slot_margin=self._slot_margin,
         )
         if self.gravity_on:
@@ -500,6 +503,18 @@ class Simulation:
         # slot_cap == 0 also covers the fold-mode grids where lists are
         # structurally unavailable (make_propagator_config leaves it 0)
         return self._lists_eligible and self._cfg.list_slot_cap > 0
+
+    # rebuild proactively below this remaining-skin fraction: the next
+    # step would likely expire mid-flight and be discarded — rebuilding
+    # now costs one sort+mark, not a wasted step
+    _LIST_SLACK_REBUILD = 0.25
+
+    def _maybe_rebuild_lists(self, diagnostics):
+        if self._use_lists and (
+            float(diagnostics.get("list_slack", 1.0))
+            < self._LIST_SLACK_REBUILD
+        ):
+            self._rebuild_lists()
 
     def _rebuild_lists(self):
         """(Re)build the persistent lists: one jitted sort + mark pass.
@@ -673,16 +688,13 @@ class Simulation:
             )
         self._apply(out)
         self.iteration += 1
-        if self._use_lists and (
-            float(diagnostics.get("list_slack", 1.0)) < 0.25
-        ):
-            # proactive rebuild while the lists are still VALID: the next
-            # step would likely expire mid-flight and be discarded —
-            # rebuilding now costs one sort+mark, not a wasted step
-            self._rebuild_lists()
         if not self._config_still_valid(diagnostics):
+            # config check FIRST: _configure() drops self._lists, so a
+            # proactive rebuild before it would be wasted work
             self._configure()
             reconfigured = True
+        else:
+            self._maybe_rebuild_lists(diagnostics)
         result = {
             k: np.asarray(v) if getattr(v, "ndim", 0) else float(v)
             for k, v in diagnostics.items()
@@ -741,15 +753,11 @@ class Simulation:
             }
             result["reconfigured"] = 0.0
             self._last_diag = result
-            if self._use_lists and (
-                float(fetched[-1].get("list_slack", 1.0)) < 0.25
-            ):
-                # proactive rebuild at the check boundary so the next
-                # window doesn't expire mid-flight and need a rollback
-                self._rebuild_lists()
             if not self._config_still_valid(fetched[-1]):
                 self._configure()
                 self._last_diag["reconfigured"] = 1.0
+            else:
+                self._maybe_rebuild_lists(fetched[-1])
             return self._last_diag
         # roll back to the window start and replay every window step
         diag_bad = fetched[bad]

@@ -259,12 +259,32 @@ def test_simulation_list_mode_matches_streaming():
 def test_simulation_list_rebuild_on_expiry():
     """Drive enough steps that drift eats the skin: the driver must
     rebuild (proactively or by discard) and keep stepping correctly."""
-    sim, diags = _run_sim(True, 12, check_every=3)
-    # noh piston flow drifts fast at dt ~ h/c: at least one rebuild
-    # beyond the initial one must have happened for 12 steps
+    from sphexa_tpu.simulation import Simulation
+
+    state, box, const = init_noh(14)
+    # a tiny skin forces frequent expiry, exercising both the proactive
+    # and the discard-and-replay recovery paths
+    sim = Simulation(state, box, const, prop="std", block=4096,
+                     backend="pallas", use_lists=True, check_every=3,
+                     list_skin_rel=0.05)
+    rebuilds = 0
+    orig = sim._rebuild_lists
+
+    def counting():
+        nonlocal rebuilds
+        rebuilds += 1
+        orig()
+
+    sim._rebuild_lists = counting
+    diags = [sim.step() for _ in range(12)]
+    sim.flush()
     assert sim._lists is not None
     slacks = [d.get("list_slack") for d in diags if "list_slack" in d]
     assert slacks, "no list diagnostics surfaced"
+    # noh piston flow drifts ~0.2 h_min/step: a 0.05*2h skin cannot
+    # survive 12 steps — the rebuild machinery must actually have fired
+    # beyond the initial build
+    assert rebuilds >= 2, f"expected expiry rebuilds, got {rebuilds}"
     # and the run stayed physical
     assert np.isfinite(float(sim.state.ttot))
     assert float(sim.state.ttot) > 0
