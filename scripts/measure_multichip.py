@@ -28,9 +28,13 @@ from sphexa_tpu.simulation import Simulation, make_propagator_config
 
 def measure(side, P):
     state, box, const = init_sedov(side)
-    sim = Simulation(state, box, const, prop="std", block=8192)
-    sim.step()
-    state, box = sim.state, sim.box
+    if side < 120:
+        # settle one step so the measured distribution is in-run, not the
+        # raw lattice; at 4M+ a CPU step costs minutes and the lattice is
+        # an adequate stand-in for the volume scaling
+        sim = Simulation(state, box, const, prop="std", block=8192)
+        sim.step()
+        state, box = sim.state, sim.box
     box = make_global_box(state.x, state.y, state.z, box)
     state, keys, _ = _sort_by_keys(state, box, "hilbert")
     cfg = make_propagator_config(state, box, const, block=8192,
@@ -63,26 +67,35 @@ def measure(side, P):
     # bytes per shard per exchange stage: window rows x (P-1) peers x
     # fields x 4B. The std step exchanges 3 stage-sets (coords+h+m for
     # density: 4f; +vol for IAD: 4f; 17f for momentum); VE exchanges 6.
-    row_bytes = 4
+    # SHIPPED rows of the sparse per-cell exchange (the default path,
+    # parallel/exchange.serve_sparse): sum of the sized per-distance
+    # buffers — compare against the true sparse need above
+    from sphexa_tpu.parallel.sizing import device_sparse_halo
+
+    hcells = device_sparse_halo(state.x, state.y, state.z, state.h, keys,
+                                box, cfg.nbr, P=P)
     win = (P - 1) * wmax
     rep = (P - 1) * S
     return dict(n=n, S=S, wmax=wmax, ratio=wmax / S,
                 win_rows=win, rep_rows=rep, saving=rep / max(win, 1),
-                sparse=sparse_mean, sparse_frac=sparse_mean / S)
+                sparse=sparse_mean, sparse_frac=sparse_mean / S,
+                shipped=sum(hcells), shipped_frac=sum(hcells) / S)
 
 
 def main():
     print(f"{'side':>5} {'n':>9} {'P':>3} {'S':>8} {'Wmax':>7} "
           f"{'Wmax/S':>7} {'rows/stage':>11} {'vs repl':>8} "
-          f"{'sparse':>8} {'sparse/S':>8}")
+          f"{'sparse':>8} {'sparse/S':>8} {'shipped':>8} {'ship/S':>7}")
     for side, P in ((16, 8), (24, 8), (32, 8), (48, 8), (64, 8),
-                    (80, 8), (48, 2), (48, 4), (48, 16)):
+                    (80, 8), (160, 8), (160, 16),
+                    (48, 2), (48, 4), (48, 16)):
         try:
             r = measure(side, P)
             print(f"{side:>5} {r['n']:>9} {P:>3} {r['S']:>8} "
                   f"{r['wmax']:>7} {r['ratio']:>7.3f} "
                   f"{r['win_rows']:>11} {r['saving']:>7.2f}x "
-                  f"{r['sparse']:>8.0f} {r['sparse_frac']:>8.3f}",
+                  f"{r['sparse']:>8.0f} {r['sparse_frac']:>8.3f} "
+                  f"{r['shipped']:>8} {r['shipped_frac']:>7.2f}",
                   flush=True)
         except Exception as e:
             print(f"{side:>5} P={P} FAILED: {type(e).__name__}: {e}"[:140],

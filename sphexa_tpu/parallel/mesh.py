@@ -65,7 +65,7 @@ def _place_aux_leaf(leaf, n: int, place, pspec, rspec):
 
 
 def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
-                      halo_window: int = 0, aux_cfg=None):
+                      halo_window: int = 0, halo_cells=(), aux_cfg=None):
     """Jit the full step with particle arrays sharded over the mesh.
 
     GSPMD partitions the entire program: the SFC sort's key exchange is the
@@ -106,7 +106,8 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
     if cfg.backend == "pallas":
         if step_fn in ({step_hydro_std, step_hydro_ve} | aux_props):
             cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p",
-                                      halo_window=halo_window)
+                                      halo_window=halo_window,
+                                      halo_cells=tuple(halo_cells))
         else:
             cfg = dataclasses.replace(cfg, backend="xla")
     if (cfg.gravity is not None and cfg.gravity.use_pallas

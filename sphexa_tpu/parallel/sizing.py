@@ -173,6 +173,81 @@ def device_halo_window(x, y, z, h, keys, box, nbr, P: int,
     return min(padded, S)
 
 
+@functools.partial(jax.jit, static_argnames=("nbr", "P"))
+def _sparse_halo_needs(x, y, z, h, keys, box, nbr, P: int):
+    """(P-1,) per-DISTANCE row needs of the sparse cell-granular halo
+    exchange: entry r-1 = max over shards k of the rows shard k needs
+    from its distance-r SFC predecessor (parallel/exchange.serve_sparse
+    ships round r in a buffer of exactly this size). Computed from the
+    same candidate-run coverage the in-step path uses, so the in-step
+    ``need > cap`` escape can only fire after genuine drift."""
+    from sphexa_tpu.parallel.exchange import _cells_of_runs, _sparse_layout
+    from sphexa_tpu.sph.pallas_pairs import group_cell_ranges
+
+    n = x.shape[0]
+    if n % P:
+        raise ValueError(f"sparse halo sizing needs n % P == 0 "
+                         f"(shard_state's contract), got {n} % {P}")
+    S = n // P
+    order = jnp.argsort(keys)
+    xs, ys, zs, hs = x[order], y[order], z[order], h[order]
+    skeys = keys[order]
+    ncells = (1 << nbr.level) ** 3
+    cid = (skeys >> KEY_DTYPE(3 * (KEY_BITS - nbr.level))).astype(jnp.int32)
+    table = jnp.concatenate([
+        jnp.zeros(1, jnp.int32),
+        jnp.cumsum(jnp.zeros(ncells, jnp.int32).at[cid].add(1)),
+    ]).astype(jnp.int32)
+    # per-SHARD group windows: the in-step prologue forms groups within
+    # each slab (rows restart at k*S), so sizing over global group
+    # boundaries would measure different bboxes whenever S % group != 0
+    # and could under-size a cap with zero drift
+    shard = lambda a: a.reshape(P, S)
+    ranges = jax.vmap(
+        lambda a, b, c, d: group_cell_ranges(a, b, c, d, None, box, nbr,
+                                             table=table)
+    )(shard(xs), shard(ys), shard(zs), shard(hs))
+    starts, lens = ranges.starts, ranges.lens  # (P, NG_s, W3)
+
+    c0, c1 = _cells_of_runs(starts, lens, table)
+    active = (lens > 0).astype(jnp.int32)
+    dest = jnp.broadcast_to(
+        jnp.arange(P, dtype=jnp.int32)[:, None, None], starts.shape
+    )
+    diff = jnp.zeros((P, ncells + 1), jnp.int32)
+    diff = diff.at[dest.ravel(), c0.ravel()].add(active.ravel())
+    diff = diff.at[dest.ravel(), c1.ravel() + 1].add(-active.ravel())
+    covered = jnp.cumsum(diff, axis=1)[:, :ncells] > 0  # (P_dest, ncells)
+
+    need = jax.vmap(
+        lambda cov: _sparse_layout(cov, table, S, P)[2]
+    )(covered)  # (P_dest, P_src)
+    j = jnp.arange(P, dtype=jnp.int32)
+    per_r = jnp.stack(
+        [need[(j + r) % P, j].max() for r in range(1, P)]
+    )  # (P-1,)
+    return per_r
+
+
+def device_sparse_halo(x, y, z, h, keys, box, nbr, P: int,
+                       margin: float = 1.4, quantum: int = 256,
+                       ) -> Tuple[int, ...]:
+    """Size the sparse exchange's static per-distance row caps (the
+    Hmax tuple of shard_halo_stage_sparse). P-1 scalars to the host."""
+    import dataclasses
+
+    n = x.shape[0]
+    S = -(-n // P)
+    if nbr.run_cap > S:
+        nbr = dataclasses.replace(nbr, run_cap=S)
+    per_r = np.asarray(fetch(_sparse_halo_needs(x, y, z, h, keys, box,
+                                                nbr, P)))
+    pad = lambda v: min(
+        int(-(-int(max(int(v), 1) * margin) // quantum) * quantum), S
+    )
+    return tuple(pad(v) for v in per_r)
+
+
 # ---------------------------------------------------------------------------
 # distributed gravity-tree build (histogram pyramid + drill-down)
 # ---------------------------------------------------------------------------

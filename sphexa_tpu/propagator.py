@@ -76,6 +76,12 @@ class PropagatorConfig:
     # per-peer halo window rows (Wmax). 0 = full peer slabs (the safe
     # all_gather-equivalent); sized tighter by estimate_halo_window
     halo_window: int = 0
+    # sparse cell-granular halo exchange: P-1 per-DISTANCE row caps
+    # (parallel/exchange.shard_halo_stage_sparse). Non-empty takes
+    # precedence over halo_window for the SPH stages; comm volume is
+    # sum(halo_cells) rows per serve and tracks the halo surface instead
+    # of degenerating to whole slabs (docs/NEXT.md round-4 measurement)
+    halo_cells: Tuple[int, ...] = ()
     # persistent-neighbor-list mode (sph/pair_lists.py): > 0 enables it
     # with this per-group chunk-slot budget; steady steps then skip the
     # global sort AND the candidate prologue, momentum ops lane-compact,
@@ -281,6 +287,20 @@ def _integrate_and_finish(
     return new_state, box, diagnostics
 
 
+def _halo_stage_fn(cfg: PropagatorConfig, nbr, P: int, S_shard: int):
+    """Choose the SPH stages' halo-exchange flavor: sparse cell-granular
+    (cfg.halo_cells, the default sized by the Simulation) or contiguous
+    per-peer windows (cfg.halo_window; also the 0 = full-slab fallback)."""
+    from sphexa_tpu.parallel import exchange as ex
+
+    axis = cfg.shard_axis
+    if cfg.halo_cells:
+        hmax = tuple(min(c, S_shard) for c in cfg.halo_cells)
+        return lambda *a: ex.shard_halo_stage_sparse(*a, nbr, P, hmax, axis)
+    Wmax = min(cfg.halo_window, S_shard) or S_shard
+    return lambda *a: ex.shard_halo_stage(*a, nbr, P, Wmax, axis)
+
+
 def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     """std pair-op stage under shard_map: per-device Mosaic kernels on the
     device's SFC slab, halos via the windowed all_to_all exchange.
@@ -306,7 +326,6 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     interpret = _pallas_interpret()
     P = cfg.mesh.shape[cfg.shard_axis]
     S_shard = state.x.shape[0] // P
-    Wmax = min(cfg.halo_window, S_shard) or S_shard
     # a merged run must fit in one source slab so the boundary split pass
     # leaves at most one remainder per run (exchange._split_runs); a raw
     # CELL wider than a slab still crosses and trips the split-overflow
@@ -314,10 +333,10 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     if nbr.run_cap > S_shard:
         nbr = dataclasses.replace(nbr, run_cap=S_shard)
 
+    stage = _halo_stage_fn(cfg, nbr, P, S_shard)
+
     def forces(box, keys, x, y, z, h, m, vx, vy, vz, temp):
-        ranges, serve, jbuf, escaped = ex.shard_halo_stage(
-            x, y, z, h, keys, box, nbr, P, Wmax, axis
-        )
+        ranges, serve, jbuf, escaped = stage(x, y, z, h, keys, box)
 
         halo1 = serve((x, y, z, m))
         rho, nc, occ = pp.pallas_density(
@@ -381,14 +400,13 @@ def _ve_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     interpret = _pallas_interpret()
     P = cfg.mesh.shape[cfg.shard_axis]
     S_shard = state.x.shape[0] // P
-    Wmax = min(cfg.halo_window, S_shard) or S_shard
     if nbr.run_cap > S_shard:
         nbr = dataclasses.replace(nbr, run_cap=S_shard)
 
+    stage = _halo_stage_fn(cfg, nbr, P, S_shard)
+
     def forces(box, min_dt, keys, x, y, z, h, m, vx, vy, vz, temp, alpha0):
-        ranges, serve, jbuf, escaped = ex.shard_halo_stage(
-            x, y, z, h, keys, box, nbr, P, Wmax, axis
-        )
+        ranges, serve, jbuf, escaped = stage(x, y, z, h, keys, box)
 
         hx, hy, hz, hh, hm = serve((x, y, z, h, m))
         xm, nc, occ = pp.pallas_xmass(

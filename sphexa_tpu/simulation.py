@@ -213,6 +213,7 @@ class Simulation:
         num_devices: Optional[int] = None,
         use_lists: bool = True,
         list_skin_rel: float = 0.2,
+        halo_mode: str = "sparse",
     ):
         self.state = state
         self.box = box
@@ -234,6 +235,14 @@ class Simulation:
         # rank count either)
         self._mesh = None
         self._halo_margin = 1.4
+        # sparse: cell-granular per-distance halo buffers (the measured
+        # fix for the degenerate contiguous windows, docs/NEXT.md);
+        # windowed: contiguous per-peer row windows (kept for equivalence
+        # tests and as a fallback)
+        if halo_mode not in ("sparse", "windowed"):
+            raise ValueError(f"halo_mode must be sparse|windowed, got "
+                             f"{halo_mode!r}")
+        self._halo_mode = halo_mode
         if num_devices is not None and num_devices > 1:
             from sphexa_tpu.parallel import make_mesh, shard_state
 
@@ -378,21 +387,30 @@ class Simulation:
         from sphexa_tpu.sfc.box import make_global_box
 
         wmax = 0
+        hcells = ()
         if self._cfg.backend == "pallas" and self.prop_name != "nbody":
-            # device-side discovery: the window scan runs as jitted
-            # scatter-min/max over the sharded arrays and ONE scalar
-            # reaches the host (parallel/sizing.py — the rank-local
+            # device-side discovery: the needs scan runs as jitted
+            # reductions over the sharded arrays and only P-1 scalars
+            # reach the host (parallel/sizing.py — the rank-local
             # assignment analog, assignment.hpp:84-122)
-            from sphexa_tpu.parallel.sizing import device_halo_window
+            from sphexa_tpu.parallel.sizing import (
+                device_halo_window, device_sparse_halo,
+            )
             from sphexa_tpu.sfc.keys import compute_sfc_keys
 
             s = self.state
             gbox = make_global_box(s.x, s.y, s.z, self.box)
             keys = compute_sfc_keys(s.x, s.y, s.z, gbox, curve=self.curve)
-            wmax = device_halo_window(
-                s.x, s.y, s.z, s.h, keys, gbox,
-                self._cfg.nbr, P=self._mesh.size, margin=self._halo_margin,
-            )
+            if self._halo_mode == "sparse":
+                hcells = device_sparse_halo(
+                    s.x, s.y, s.z, s.h, keys, gbox, self._cfg.nbr,
+                    P=self._mesh.size, margin=self._halo_margin,
+                )
+            else:
+                wmax = device_halo_window(
+                    s.x, s.y, s.z, s.h, keys, gbox, self._cfg.nbr,
+                    P=self._mesh.size, margin=self._halo_margin,
+                )
         aux_cfg = None
         if self.prop_name == "turb-ve":
             aux_cfg = self.turb_cfg
@@ -400,7 +418,7 @@ class Simulation:
             aux_cfg = self.cooling_cfg
         self._stepper = make_sharded_step(
             self._mesh, self._cfg, _PROPAGATORS[self.prop_name],
-            halo_window=wmax, aux_cfg=aux_cfg,
+            halo_window=wmax, halo_cells=hcells, aux_cfg=aux_cfg,
         )
 
     def _configure_gravity(self, margin: float):

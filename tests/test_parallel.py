@@ -774,3 +774,110 @@ class TestDeviceSizing:
         assert sizing.TRANSFER_BYTES < budget, (
             sizing.TRANSFER_BYTES, budget
         )
+
+
+class TestSparseHaloExchange:
+    """Sparse cell-granular halo exchange (shard_halo_stage_sparse): comm
+    volume tracks the halo SURFACE via per-distance ppermute buffers — the
+    exchangeHalos analog (exchange_halos.hpp:43-119) replacing the
+    contiguous windows that measured degenerate (Wmax = S at every size,
+    docs/NEXT.md round-4). These tests run at 40^3 where the per-distance
+    needs are genuinely partial (VERDICT r4 weak #5): max cap < S and the
+    total is ~5.6 slabs vs the windowed path's degenerate 7."""
+
+    @staticmethod
+    def _sparse_caps(state, box, nbr, P=8):
+        from sphexa_tpu.parallel.sizing import device_sparse_halo
+        from sphexa_tpu.sfc.box import make_global_box
+        from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+        gbox = make_global_box(state.x, state.y, state.z, box)
+        keys = compute_sfc_keys(state.x, state.y, state.z, gbox)
+        return device_sparse_halo(
+            state.x, state.y, state.z, state.h, keys, gbox, nbr, P=P
+        )
+
+    def test_sizing_volume_tracks_surface(self):
+        """The sized per-distance caps ship strictly less than the
+        all_gather-equivalent volume, with at least one genuinely
+        partial distance — the regime the windowed path never reached."""
+        state, box, const = init_sedov(40)  # 64000 / 8
+        cfg = make_cfg(state, box, const)
+        hc = self._sparse_caps(state, box, cfg.nbr)
+        S = -(-state.n // 8)
+        assert len(hc) == 7
+        assert sum(hc) < 0.85 * 7 * S, (hc, S)
+        assert min(hc) < 0.6 * S, (hc, S)
+
+    def test_sparse_std_matches_single_partial_windows(self):
+        """One std step, 8 shards, sparse exchange in the partial-cap
+        regime vs the single-device step."""
+        from sphexa_tpu.propagator import step_hydro_std
+
+        state, box, const = init_sedov(40)
+        cfg = make_propagator_config(state, box, const, backend="pallas")
+        ref_state, _, ref_diag = step_hydro_std(state, box, cfg)
+
+        hc = self._sparse_caps(state, box, cfg.nbr)
+        S = -(-state.n // 8)
+        assert max(hc) < S, "regime check: caps must be partial"
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg, halo_cells=hc)
+        out_state, _, out_diag = step(sstate, box)
+        assert int(out_diag["occupancy"]) <= cfg.nbr.cap
+        np.testing.assert_allclose(
+            np.asarray(out_state.x), np.asarray(ref_state.x),
+            rtol=1e-5, atol=1e-7,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_state.temp), np.asarray(ref_state.temp),
+            rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            float(out_diag["dt"]), float(ref_diag["dt"]), rtol=1e-5
+        )
+
+    def test_sparse_escape_sentinel_trips(self):
+        """Undersized per-distance caps must surface as the occupancy
+        cap+1 sentinel (the shared overflow contract), not wrong physics."""
+        from sphexa_tpu.propagator import step_hydro_std
+
+        state, box, const = init_sedov(16)
+        cfg = make_propagator_config(state, box, const, block=512,
+                                     backend="pallas")
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg, halo_cells=(64,) * 7)
+        _, _, diag = step(sstate, box)
+        assert int(diag["occupancy"]) == cfg.nbr.cap + 1
+
+    @pytest.mark.slow
+    def test_sparse_ve_matches_single_512k(self):
+        """VERDICT r4 next #2 'Done' gate: equivalence AND exchanged-row
+        volume in a genuinely-partial regime at 512k/8 (the size where
+        the sparse need measured 1.27 slabs and shrinking)."""
+        from sphexa_tpu.propagator import step_hydro_ve
+
+        state, box, const = init_sedov(80)  # 512000 / 8
+        cfg = make_propagator_config(state, box, const, backend="pallas")
+        hc = self._sparse_caps(state, box, cfg.nbr)
+        S = -(-state.n // 8)
+        # volume: the padded total must stay well under all_gather volume
+        # (measured 2.50 slabs vs 7 at this size)
+        assert sum(hc) < 0.45 * 7 * S, (hc, S)
+        ref_state, _, _ = step_hydro_ve(state, box, cfg)
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg, halo_cells=hc,
+                                 step_fn=step_hydro_ve)
+        out_state, _, out_diag = step(sstate, box)
+        assert int(out_diag["occupancy"]) <= cfg.nbr.cap
+        np.testing.assert_allclose(
+            np.asarray(out_state.x), np.asarray(ref_state.x),
+            rtol=1e-5, atol=1e-7,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_state.temp), np.asarray(ref_state.temp),
+            rtol=1e-3, atol=1e-6,
+        )
