@@ -1,0 +1,156 @@
+"""Microbench the list-walk engine's per-chunk lane-compaction cost on
+real TPU hardware.
+
+The walk engine (sph/pallas_pairs.py group_pair_engine_lists) pays a
+fixed per-marked-chunk cost: lane gather (take_along_axis), image-shift
+add, staged-index insert, and two staging-window selects. From the
+measured op times (momentum walk 123 ms = 27 chunks compaction + 9
+chunks math at 100^3) that fixed cost is ~145 ns/chunk — as expensive as
+the 60-op momentum math itself, and the reason cheap ops (density/IAD)
+stay on skip-streaming. This bench isolates the candidates:
+
+  loop      — DMA-less chunk loop, accumulate one row (floor)
+  gather    — + take_along_axis lane gather on the (8, 128) chunk
+  onehot    — + MXU permute: build (128,128) one-hot from the index row
+              in-kernel, chunk @ P (same result as gather)
+  full      — the engine's whole compaction block (gather variant)
+  fullmxu   — the whole block with the one-hot permute instead
+
+Timing: dependent-scalar barrier, first batch discarded (docs/NEXT.md).
+"""
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def make_kernel(variant: str, S: int, nf: int):
+    def kernel(gidx_ref, cnt_r, fill_r, data_ref, out_ref, stage):
+        lane_f = jax.lax.broadcasted_iota(jnp.int32, (nf, 128), 1)
+        subl = jax.lax.broadcasted_iota(jnp.int32, (nf, 128), 0)
+        iota_r = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 0)
+
+        def body(t, acc):
+            chunk = data_ref[0, t]
+            cnt = cnt_r[0, 0, t]
+            fill = fill_r[0, 0, t]
+            gi_row = gidx_ref[0, t][None, :]  # (1, 128)
+            if variant == "loop":
+                acc = acc + chunk
+            elif variant == "gather":
+                rolled = jnp.take_along_axis(
+                    chunk, jnp.broadcast_to(gi_row, (nf, 128)), axis=1)
+                acc = acc + rolled
+            elif variant == "onehot":
+                P = (iota_r == gi_row).astype(jnp.float32)  # (128,128)
+                rolled = jax.lax.dot_general(
+                    chunk, P, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                acc = acc + rolled
+            elif variant in ("full", "fullmxu"):
+                if variant == "full":
+                    rolled = jnp.take_along_axis(
+                        chunk, jnp.broadcast_to(gi_row, (nf, 128)), axis=1)
+                else:
+                    P = (iota_r == gi_row).astype(jnp.float32)
+                    rolled = jax.lax.dot_general(
+                        chunk, P, (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                shift_col = jnp.where(
+                    subl[:, :1] == 0, 1.0,
+                    jnp.where(subl[:, :1] == 1, 2.0,
+                              jnp.where(subl[:, :1] == 2, 3.0, 0.0)))
+                rolled = rolled + shift_col
+                idx_f = (t * 128 + gi_row).astype(jnp.float32)
+                rolled = jnp.where(
+                    subl == nf - 1,
+                    jnp.broadcast_to(idx_f, rolled.shape), rolled)
+                m0 = (lane_f >= fill) & (lane_f < fill + cnt)
+                m1 = lane_f < (fill + cnt - 128)
+                stage[:, :128] = jnp.where(m0, rolled, stage[:, :128])
+                stage[:, 128:] = jnp.where(m1, rolled, stage[:, 128:])
+                acc = acc + stage[:, :128]
+            return acc
+
+        stage[...] = jnp.zeros((nf, 256), jnp.float32)
+        acc = jax.lax.fori_loop(0, S, body, jnp.zeros((nf, 128), jnp.float32))
+        out_ref[0] = acc
+
+    return kernel
+
+
+def run(variant: str, NG: int, S: int, nf: int, reps: int):
+    kern = make_kernel(variant, S, nf)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(NG,),
+        in_specs=[
+            pl.BlockSpec((1, S, 128), lambda g: (g, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda g: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, S), lambda g: (g, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, S, nf, 128), lambda g: (g, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nf, 128), lambda g: (g, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((nf, 256), jnp.float32)],
+    )
+    f = pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((NG, nf, 128), jnp.float32),
+    )
+    rng = np.random.default_rng(0)
+    gidx = jnp.asarray(
+        np.argsort(rng.random((NG, S, 128)), axis=-1).astype(np.int32))
+    cnt = jnp.asarray(rng.integers(0, 129, (NG, 1, S)).astype(np.int32))
+    fill = jnp.asarray(rng.integers(0, 128, (NG, 1, S)).astype(np.int32))
+    data = jnp.asarray(rng.random((NG, S, nf, 128)).astype(np.float32))
+
+    @jax.jit
+    def step(seed):
+        # chain a dependency through the data so calls serialize
+        out = f(gidx, cnt, fill, data + seed * 1e-12)
+        return jnp.sum(out[:, 0, :1])
+
+    s = step(jnp.float32(0))
+    float(s)  # compile + discard first batch
+    t0 = time.perf_counter()
+    v = jnp.float32(0)
+    for i in range(reps):
+        v = step(v * 1e-30 + i)
+    float(v)
+    dt = (time.perf_counter() - t0) / reps
+    per_chunk = dt / (NG * S) * 1e9
+    print(f"{variant:8s}: {dt*1e3:8.2f} ms/call  {per_chunk:7.1f} ns/chunk")
+    return per_chunk
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ng", type=int, default=2048)
+    ap.add_argument("--slots", type=int, default=27)
+    ap.add_argument("--nf", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=10)
+    args = ap.parse_args()
+    print(f"NG={args.ng} S={args.slots} nf={args.nf}")
+    base = None
+    for v in ("loop", "gather", "onehot", "full", "fullmxu"):
+        t = run(v, args.ng, args.slots, args.nf, args.reps)
+        if v == "loop":
+            base = t
+        else:
+            print(f"          marginal vs loop: {t - base:7.1f} ns/chunk")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,8 @@ def main():
     ap.add_argument("-n", type=int, default=100)
     ap.add_argument("--skin-rel", type=float, default=0.2,
                     help="skin as a fraction of 2*h_max")
+    ap.add_argument("--ve", action="store_true",
+                    help="also measure the VE ops walk-vs-skip")
     args = ap.parse_args()
 
     state, box, const = init_sedov(args.n)
@@ -112,6 +114,43 @@ def main():
     da = float(jnp.max(jnp.abs(o0[0] - o1[0]))) / sc
     print(f"momentum  : stream {t0*1e3:7.1f} ms  lists {t1*1e3:7.1f} ms  "
           f"x{t0/t1:.2f}  dax={da:.2e}")
+
+    if not args.ve:
+        return
+
+    # ---- VE ops: walk vs chunk-skip list modes
+    from sphexa_tpu.sph.hydro_ve import compute_eos_ve
+
+    t_xm, (xm, _, _) = timed(
+        jax.jit(lambda ls, *a: pp.pallas_xmass(*a, None, box, const, nbr,
+                                               lists=ls)),
+        lists, x, y, z, h, m)
+    (kx, gradh), _ = pp.pallas_ve_def_gradh(x, y, z, h, m, xm, None, box,
+                                            const, nbr, lists=lists)
+    prho, cve, rhove, pve = compute_eos_ve(ss.temp, m, kx, xm, gradh, const)
+    dv_args = (x, y, z, ss.vx, ss.vy, ss.vz, h, kx, xm, *cs0)
+    f_w = jax.jit(lambda ls, *a: pp.pallas_iad_divv_curlv(
+        *a, None, box, const, nbr, lists=ls, list_walk=True))
+    f_k = jax.jit(lambda ls, *a: pp.pallas_iad_divv_curlv(
+        *a, None, box, const, nbr, lists=ls, list_walk=False))
+    tw, ow = timed(f_w, lists, *dv_args)
+    tk, ok_ = timed(f_k, lists, *dv_args)
+    dd = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(ow[0], ok_[0]))
+    print(f"divv_curlv: skip   {tk*1e3:7.1f} ms  walk  {tw*1e3:7.1f} ms  "
+          f"x{tk/tw:.2f}  d={dd:.2e}")
+
+    divv, curlv = ow[0][0], ow[0][1]
+    av_args = (x, y, z, ss.vx, ss.vy, ss.vz, h, cve, kx, xm, divv,
+               ss.alpha, *cs0)
+    f_w = jax.jit(lambda ls, *a: pp.pallas_av_switches(
+        *a, None, box, 1e-5, const, nbr, lists=ls, list_walk=True))
+    f_k = jax.jit(lambda ls, *a: pp.pallas_av_switches(
+        *a, None, box, 1e-5, const, nbr, lists=ls, list_walk=False))
+    tw, aw = timed(f_w, lists, *av_args)
+    tk, ak = timed(f_k, lists, *av_args)
+    dd = float(jnp.max(jnp.abs(aw[0] - ak[0])))
+    print(f"av_switch : skip   {tk*1e3:7.1f} ms  walk  {tw*1e3:7.1f} ms  "
+          f"x{tk/tw:.2f}  d={dd:.2e}")
 
 
 if __name__ == "__main__":

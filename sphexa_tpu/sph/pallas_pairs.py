@@ -1501,7 +1501,7 @@ def pallas_iad_divv_curlv(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, with_gradv: bool = False, interpret: bool = False,
-    jdata=None, i_offset=0, lists=None,
+    jdata=None, i_offset=0, lists=None, list_walk: bool = False,
 ):
     """Velocity divergence/curl through the IAD gradient
     (divv_curlv_kern.hpp:43-120), optionally the full symmetrized
@@ -1584,6 +1584,18 @@ def pallas_iad_divv_curlv(
     jf = jdata or (x, y, z, xm, vx, vy, vz)
     f = lambda a: a.reshape(-1)[:n]
     if lists is not None:
+        if list_walk:
+            # measured a WASH vs chunk-skip at 80^3 (59.1 vs 58.2 ms,
+            # scripts/bench_lists.py --ve) — skip stays the default;
+            # the walk path is kept selectable for heavier-body variants
+            engine = group_pair_engine_lists(
+                pair_body, finalize, num_i=15, num_j=7,
+                num_acc=9 if with_gradv else 4, cfg=cfg,
+                interpret=interpret, want_nc=False,
+            )
+            jp = pack_j_fields(jf, cfg.dma_cap)
+            *outs, _nc = engine(lists, i_fields, jp, i_offset)
+            return tuple(f(a) for a in outs), lists.ranges.occupancy
         engine = group_pair_engine(
             pair_body, finalize, num_i=15, num_j=7,
             num_acc=9 if with_gradv else 4, cfg=cfg,
@@ -1610,7 +1622,7 @@ def pallas_av_switches(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, dt, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
-    lists=None,
+    lists=None, list_walk: bool = True,
 ):
     """Per-particle viscosity switch evolution (av_switches_kern.hpp:43-137)
     with the search fused in. Returns (alpha_new (n,), occupancy).
@@ -1689,6 +1701,17 @@ def pallas_av_switches(
     )
     jf = jdata or (x, y, z, c, vx, vy, vz, xm / kx, divv)
     if lists is not None:
+        if list_walk:
+            # rsqrt + signal-velocity max make this body heavy enough
+            # for lane compaction: 62.0 vs 67.4 ms at 80^3
+            # (scripts/bench_lists.py --ve)
+            engine = group_pair_engine_lists(
+                pair_body, finalize, num_i=19, num_j=9, num_acc=4,
+                cfg=cfg, interpret=interpret, want_nc=False,
+            )
+            jp = pack_j_fields(jf, cfg.dma_cap, nf_min=10)
+            alpha_new, _nc = engine(lists, i_fields, jp, i_offset)
+            return alpha_new.reshape(-1)[:n], lists.ranges.occupancy
         engine = group_pair_engine(
             pair_body, finalize, num_i=19, num_j=9, num_acc=4, cfg=cfg,
             fold=False, interpret=interpret, chunk_skip=False,
