@@ -139,7 +139,22 @@ def estimate_gravity_caps(
     geo_size = np.asarray(halfsize_frac)[:, None] * lengths[None, :]
     l_node = 2.0 * geo_size.max(axis=1)
     s_off = np.linalg.norm(com - geo_center, axis=1)
-    mac2 = (l_node / cfg.theta + s_off) ** 2
+    # monotone MAC radius + subtree com box — MUST match
+    # compute_gravity's upsweeps or the sampled caps drift from the
+    # real classification
+    smax = np.where(valid, s_off, 0.0)
+    BIG = 1e15  # squares stay finite in f32
+    com_lo = np.where(valid[:, None], com, BIG)
+    com_hi = np.where(valid[:, None], com, -BIG)
+    for s, e in reversed(meta.level_ranges[1:]):
+        np.maximum.at(smax, parent[s:e], smax[s:e])
+        np.minimum.at(com_lo, parent[s:e], com_lo[s:e])
+        np.maximum.at(com_hi, parent[s:e], com_hi[s:e])
+    ccenter = np.where(valid[:, None], 0.5 * (com_lo + com_hi), BIG)
+    chalf = np.where(valid[:, None],
+                     np.maximum(0.5 * (com_hi - com_lo), 0.0), 0.0)
+    mac2 = (l_node / cfg.theta + smax) ** 2
+    self_parent = parent == np.arange(meta.num_nodes)
 
     rng = np.random.default_rng(0)
     blocks = (
@@ -152,18 +167,19 @@ def estimate_gravity_caps(
         pmin = bmin[b0:b1].min(axis=0)
         pmax = bmax[b0:b1].max(axis=0)
         bc, bs = (pmax + pmin) / 2, (pmax - pmin) / 2
-        d = np.maximum(np.abs(bc[None, :] - com) - bs[None, :], 0.0)
+        d = np.maximum(
+            np.abs(bc[None, :] - ccenter) - bs[None, :] - chalf, 0.0
+        )
         accept = valid & ~((d * d).sum(axis=1) < mac2)
-        anc = np.zeros(meta.num_nodes, dtype=bool)
-        for s, e in meta.level_ranges[1:]:
-            anc[s:e] = anc[parent[s:e]] | accept[parent[s:e]]
+        # monotone MAC: accepted strict ancestor == accepted parent
+        anc = np.where(self_parent, False, accept[parent])
         return accept, anc
 
     m2p_max, p2p_max = 1, 1
     for b in blocks:
         accept, anc = classify(b, b + 1)
         m2p_max = max(m2p_max, int((accept & ~anc).sum()))
-        p2p_max = max(p2p_max, int((is_leaf & valid & ~accept & ~anc).sum()))
+        p2p_max = max(p2p_max, int((is_leaf & valid & ~accept).sum()))
 
     # superblock candidate-list high water (the hierarchical MAC's cap):
     # ~anc = open set + accepted cut of the super bbox
@@ -219,10 +235,13 @@ def compute_multipoles(
     """
     lk = tree.leaf_keys
     num_l, num_n = meta.num_leaves, meta.num_nodes
+    n = x.shape[0]
     edges = jnp.searchsorted(sorted_keys, lk, side="left").astype(jnp.int32)
-    pleaf = (
-        jnp.searchsorted(lk, sorted_keys, side="right").astype(jnp.int32) - 1
-    )
+    # particle -> leaf index WITHOUT the N-query u64 searchsorted (emulated
+    # u64 compares x log2(L) gathers measured ~150 ms at 1M): leaf rows are
+    # contiguous, so pleaf = (#leaf starts <= row) - 1 — one O(L) scatter
+    # + O(N) cumsum over int32 rows
+    pleaf = _pleaf_from_edges(edges, n)
 
     # pass 1: monopole + center of mass, leaves then upsweep. Processing
     # levels deepest-first means a node's own subtree sum is complete by the
@@ -249,6 +268,14 @@ def compute_multipoles(
                          edges=edges)  # (L, 7)
     node_q = _upsweep_quadrupoles(leaf_q, node_mass, node_com, tree, meta)
     return node_mass, node_com, node_q, edges
+
+
+def _pleaf_from_edges(edges, n: int):
+    """(n,) particle->leaf map from the (L+1 or L,) sorted leaf start
+    rows: cumsum of a start-row indicator. Empty leaves (duplicate
+    edges) advance the count twice and simply never appear."""
+    mark = jnp.zeros(n + 1, jnp.int32).at[edges].add(1)
+    return jnp.cumsum(mark)[:n] - 1
 
 
 def _upsweep_mass_com(leaf_w, tree, meta):
@@ -298,9 +325,10 @@ def compute_multipoles_sharded(
     pos_local = jnp.searchsorted(local_keys, lk, side="left").astype(jnp.int32)
     edges = jax.lax.psum(pos_local, axis)  # global leaf boundary rows
     e_clip = jnp.clip(edges - k * S, 0, S)
-    pleaf = (
-        jnp.searchsorted(lk, local_keys, side="right").astype(jnp.int32) - 1
-    )
+    # local-row particle->leaf map: leaves starting before the slab clip
+    # to 0 (counted for every local row), after it to S (never counted) —
+    # same contiguous-rows identity as the single-device path
+    pleaf = _pleaf_from_edges(e_clip, S)
 
     w = jnp.stack([m, m * x, m * y, m * z], axis=1)
     leaf_w = jax.lax.psum(mp.edge_segment_sum(w, e_clip), axis)  # (L, 4)
@@ -456,11 +484,43 @@ def compute_gravity(
     lo = jnp.stack([box.lo[0], box.lo[1], box.lo[2]])
     geo_center = lo[None, :] + tree.center_frac * lengths[None, :]  # (N, 3)
     geo_size = tree.halfsize_frac[:, None] * lengths[None, :]  # (N, 3)
-    # vector MAC acceptance radius around the expansion center
-    # (macs.hpp computeVecMacR2: l = 2*max(geoSize), mac = l/theta + |com - geo|)
+    # MONOTONE vector-MAC acceptance (macs.hpp computeVecMacR2 role, made
+    # hierarchy-monotone): radius l/theta + max-over-subtree(|com - geo|),
+    # distance measured from the target bbox to the node's GEO BOX. Since
+    # child boxes nest and the radius is non-increasing down the tree,
+    # accept(parent) => accept(child) — so "first accepted ancestor"
+    # collapses to ONE parent lookup (no per-level downsweep, the 210 ms
+    # phase at 1M, scripts/profile_gravity_phases.py) and
+    # p2p = leaf & ~accept needs no ancestor chain at all. Validity: the
+    # true com distance >= box distance (com inside the box) and the
+    # monotone radius >= the node's own l/theta + s_off, so every
+    # acceptance satisfies the original vector-MAC error criterion —
+    # strictly conservative (measured ~+15% m2p work, traded for the
+    # whole downsweep).
     l_node = 2.0 * jnp.max(geo_size, axis=1)
     s_off = jnp.sqrt(jnp.sum((node_com - geo_center) ** 2, axis=1))
-    mac2 = (l_node / cfg.theta + s_off) ** 2  # (N,)
+    # empty nodes have no com (mass 0 -> com (0,0,0)); their bogus
+    # s_off must not inflate any ancestor's monotone radius
+    smax = jnp.where(valid, s_off, 0.0)
+    # subtree com BOUNDING BOX: nests under the hierarchy like the geo
+    # box (subtree com sets are subsets) but collapses toward a point at
+    # depth, so the box-to-box distance below stays nearly as tight as
+    # the reference's com-point distance where it matters (the deep
+    # acceptance cut) — using the geo box instead measured ~2x more
+    # accepted nodes at 1M/theta=0.5
+    BIG = jnp.float32(1e15)  # "infinitely far"; squares stay finite in f32
+    com_lo = jnp.where(valid[:, None], node_com, BIG)
+    com_hi = jnp.where(valid[:, None], node_com, -BIG)
+    for s, e in reversed(meta.level_ranges[1:]):
+        par = tree.parent[s:e]
+        smax = smax.at[par].max(smax[s:e])
+        com_lo = com_lo.at[par].min(com_lo[s:e])
+        com_hi = com_hi.at[par].max(com_hi[s:e])
+    ccenter = jnp.where(valid[:, None], 0.5 * (com_lo + com_hi), BIG)
+    chalf = jnp.where(valid[:, None],
+                      jnp.maximum(0.5 * (com_hi - com_lo), 0.0), 0.0)
+    mac2 = (l_node / cfg.theta + smax) ** 2  # (N,)
+    self_parent = tree.parent == jnp.arange(num_n, dtype=tree.parent.dtype)
 
     blk = cfg.target_block
     num_blocks = -(-n // blk)
@@ -501,9 +561,13 @@ def compute_gravity(
         )
         return bc, bs
 
-    def _accept(bc, bs, com, m2):
-        # evaluateMac (macs.hpp): distance from target box to expansion center
-        d = jnp.maximum(jnp.abs(bc[None, :] - com) - bs[None, :], 0.0)
+    def _accept(bc, bs, gc, gs, m2):
+        # box-to-box distance vs the monotone MAC radius (see above);
+        # nested node boxes make this monotone where the reference's
+        # com-distance evaluateMac (macs.hpp) is not
+        d = jnp.maximum(
+            jnp.abs(bc[None, :] - gc) - bs[None, :] - gs, 0.0
+        )
         return jnp.sum(d * d, axis=1) >= m2
 
     sf = cfg.super_factor
@@ -524,11 +588,9 @@ def compute_gravity(
         def one_super(si):
             bc, bs = _bbox(x[si] + shift[0], y[si] + shift[1],
                            z[si] + shift[2])
-            accept = valid & _accept(bc, bs, node_com, mac2)
-            anc = jnp.zeros(num_n, dtype=bool)
-            for s, e in meta.level_ranges[1:]:
-                par = tree.parent[s:e]
-                anc = anc.at[s:e].set(anc[par] | accept[par])
+            accept = valid & _accept(bc, bs, ccenter, chalf, mac2)
+            # monotone MAC: an accepted strict ancestor == accepted parent
+            anc = jnp.where(self_parent, False, accept[tree.parent])
             cand = ~anc  # open nodes + the accepted cut (ancestor-closed)
             ordc = jnp.argsort(~cand, stable=True)[:scap]
             cok = cand[ordc]
@@ -550,7 +612,6 @@ def compute_gravity(
         scand_ok = scand_ok.reshape(-1, scap)
         spar = spar.reshape(-1, scap)
         c_max = jnp.max(scand_n)
-        n_levels = len(meta.level_ranges)
 
     def one_block(bi, bnum):
         """bi: (blk,) particle indices of one target group; bnum: its
@@ -564,48 +625,48 @@ def compute_gravity(
             cok = scand_ok[sid]
             ppos = spar[sid]
             accept = cok & valid[cidx] & _accept(
-                bc, bs, node_com[cidx], mac2[cidx]
+                bc, bs, ccenter[cidx], chalf[cidx], mac2[cidx]
             )
-            # downsweep within the candidate list: parents are strictly
-            # shallower and the list is ancestor-closed, so n_levels
-            # fixed-point passes of the remapped-parent gather converge.
+            # monotone MAC: the first accepted ancestor IS the parent.
             # The root's parent is ITSELF — mask self-parents or an
             # accepted root (far replica shifts) would mark itself as its
-            # own accepted ancestor and zero the whole interaction (the
-            # dense path's level_ranges[1:] slice does the same exclusion)
+            # own accepted ancestor and zero the whole interaction
             not_self = cidx[ppos] != cidx
-            anc = jnp.zeros(cidx.shape, dtype=bool)
-            for _ in range(n_levels):
-                anc = (anc[ppos] | accept[ppos]) & not_self
+            anc = accept[ppos] & not_self
             m2p_mask = accept & ~anc
-            p2p_mask = cok & tree.is_leaf[cidx] & valid[cidx] & ~accept & ~anc
+            p2p_mask = cok & tree.is_leaf[cidx] & valid[cidx] & ~accept
         else:
             cidx = None
-            accept = valid & _accept(bc, bs, node_com, mac2)
-            # first-accepted-ancestor downsweep over the full level-major
-            # node array (dense fallback, super_factor=0)
-            anc = jnp.zeros(num_n, dtype=bool)
-            for s, e in meta.level_ranges[1:]:
-                par = tree.parent[s:e]
-                anc = anc.at[s:e].set(anc[par] | accept[par])
+            accept = valid & _accept(bc, bs, ccenter, chalf, mac2)
+            # monotone MAC (see mac2 above): one parent gather replaces
+            # the per-level first-accepted-ancestor downsweep, and
+            # ~accept already implies no accepted ancestor for leaves
+            anc = jnp.where(self_parent, False, accept[tree.parent])
             m2p_mask = accept & ~anc
-            p2p_mask = tree.is_leaf & valid & ~accept & ~anc
+            p2p_mask = tree.is_leaf & valid & ~accept
         m2p_n = jnp.sum(m2p_mask)
         p2p_n = jnp.sum(p2p_mask)
 
-        # ONE stable 3-class sort compacts both interaction lists (two
-        # argsorts doubled the dominant per-block cost): class-0 nodes
+        # ONE 3-class sort compacts both interaction lists: class-0 nodes
         # (M2P) land first, class-1 (P2P leaves) directly after, so the
-        # P2P list is a dynamic slice at the M2P count
+        # P2P list is a dynamic slice at the M2P count. The class and the
+        # node index ride in one PACKED int32 key (class in the top bits,
+        # index below) — a single single-operand sort where a stable
+        # argsort + sort pair cost ~2x (the 208 ms phase at 1M,
+        # scripts/profile_gravity_phases.py); unique keys make it
+        # order-preserving within a class by construction
         cls = jnp.where(m2p_mask, 0, jnp.where(p2p_mask, 1, 2))
-        order_all = jnp.argsort(cls.astype(jnp.int32), stable=True)
+        cls_len = cls.shape[0]
+        nbits = max(1, int(np.ceil(np.log2(max(cls_len, 2)))))
+        iota_k = jnp.arange(cls_len, dtype=jnp.int32)
+        ks = jnp.sort((cls.astype(jnp.int32) << nbits) | iota_k)
+        order_all = ks & jnp.int32((1 << nbits) - 1)
+        cls_sorted = ks >> nbits
         if cidx is not None:
             order_all = cidx[order_all]
-        # masks travel with the sort: the sorted class vector marks which
-        # compacted slots are real M2P/P2P entries. Sentinel-pad so the
-        # fixed-cap slices below stay in range when the candidate list is
-        # shorter than a cap (tiny trees / small super lists).
-        cls_sorted = jnp.sort(cls.astype(jnp.int32), stable=True)
+        # sentinel-pad so the fixed-cap slices below stay in range when
+        # the candidate list is shorter than a cap (tiny trees / small
+        # super lists)
         padn = max(cfg.m2p_cap, cfg.p2p_cap)
         order_all = jnp.concatenate(
             [order_all, jnp.full((padn,), num_n - 1, order_all.dtype)]
