@@ -66,6 +66,11 @@ class CoolingConfig:
     substeps: int = 8               # sub-cycles of the semi-implicit update
     logT_table: Tuple[float, ...] = tuple(_LOGT_TABLE)
     logL_table: Tuple[float, ...] = tuple(_LOGL_TABLE)
+    # evolve the 6-species primordial network (physics/primordial.py) in
+    # place of the CIE table: species ODEs + composition-resolved cooling
+    # per step, the cooler.cpp solve_chemistry role. False keeps the
+    # metal-inclusive CIE curve with diagnostic-only fractions.
+    evolve_species: bool = False
 
     @property
     def t_code_s(self) -> float:
@@ -219,6 +224,28 @@ def cool_particles(dt, rho_code, u_code, chem: ChemistryData, cfg: CoolingConfig
 
     u_final, _ = jax.lax.scan(body, u_code, None, length=cfg.substeps)
     return (u_final - u_code) / dt
+
+
+def cool_step(dt, rho_code, u_code, chem: ChemistryData, cfg: CoolingConfig):
+    """One cooling source update: (du_avg, new ChemistryData).
+
+    Dispatches on cfg.evolve_species — the evolved primordial network
+    (physics/primordial.py, the cooler.cpp:313 solve_chemistry role) or
+    the CIE table with pass-through fractions."""
+    if cfg.evolve_species:
+        from sphexa_tpu.physics.primordial import evolve_primordial
+
+        return evolve_primordial(dt, rho_code, u_code, chem, cfg)
+    return cool_particles(dt, rho_code, u_code, chem, cfg), chem
+
+
+def cool_timestep(rho_code, u_code, chem: ChemistryData, cfg: CoolingConfig):
+    """ct_crit cooling-time limiter, dispatching like cool_step."""
+    if cfg.evolve_species:
+        from sphexa_tpu.physics.primordial import primordial_cooling_timestep
+
+        return primordial_cooling_timestep(rho_code, u_code, chem, cfg)
+    return cooling_timestep(rho_code, u_code, chem, cfg)
 
 
 def eos_cooling(rho_code, u_code, chem: ChemistryData, cfg: CoolingConfig):

@@ -610,7 +610,7 @@ def step_hydro_std_cooling(
     The per-particle chemistry rides the step's SFC sort and the permuted
     ChemistryData is returned so it stays aligned with the persisted state.
     """
-    from sphexa_tpu.physics.cooling import cool_particles, cooling_timestep
+    from sphexa_tpu.physics.cooling import cool_step, cool_timestep
 
     const = cfg.const
     (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
@@ -618,11 +618,13 @@ def step_hydro_std_cooling(
                                 lists=lists)
 
     u = const.cv * state.temp
-    dt_cool = cooling_timestep(rho, u, chem, cool_cfg)
+    dt_cool = cool_timestep(rho, u, chem, cool_cfg)
     dt = compute_timestep(
         state.min_dt, dt_courant, dt_cool, *extra_dts, const=const
     )
-    du_cool = cool_particles(dt, rho, u, chem, cool_cfg)
+    # evolved-network mode advances the species alongside u
+    # (solve_chemistry, cooler.cpp:313); CIE mode passes chem through
+    du_cool, chem = cool_step(dt, rho, u, chem, cool_cfg)
     du = du + du_cool
 
     gdiag = {**(gdiag or {}), "dt_cool": dt_cool,

@@ -179,3 +179,160 @@ class TestCoolingPropagator:
         # radiative losses: internal energy decreases relative to the
         # adiabatic run (collapse heating is tiny after 3 steps)
         assert float(e1["eint"]) < float(e0["eint"]) * 1.001
+
+
+class TestPrimordialNetwork:
+    """Evolved 6-species primordial chemistry (physics/primordial.py) —
+    the cooler.cpp:313 solve_chemistry role (VERDICT r4 #6). The CIE
+    equilibrium-limit pins come from the analytic ionization balance
+    (rate-coefficient ratios; density cancels)."""
+
+    @staticmethod
+    def _cfg(**kw):
+        from sphexa_tpu.physics.cooling import KPC, MH, CoolingConfig
+
+        # unit scales chosen so n_H [cm^-3] == rho_code and the rates are
+        # fast in code time (t_code ~ 3e15 s): equilibrium in a few calls
+        l_cm = KPC
+        return CoolingConfig(
+            m_code_g=MH * l_cm**3, l_code_cm=l_cm, substeps=32,
+            evolve_species=True, **kw,
+        )
+
+    @staticmethod
+    def _neutral(n, x=0.76, seed=1e-4):
+        """Near-neutral IC with a TINY ionized seed: the collisional
+        network's rates all carry a factor y_e, so exactly-zero
+        electrons is a (unphysical) frozen fixed point — real ICs are
+        never exactly neutral."""
+        import jax.numpy as jnp
+
+        from sphexa_tpu.physics.cooling import ChemistryData
+
+        f = lambda v: jnp.full(n, v, jnp.float32)
+        return ChemistryData(hi=f(x - seed), hii=f(seed), hei=f(1.0 - x),
+                            heii=f(0.0), heiii=f(0.0), e=f(seed),
+                            metal=f(0.0))
+
+    def _relax(self, T, rho=1.0):
+        """Species-only relaxation at fixed temperature (the coupled
+        solver would cool the gas off T within one call at these fast
+        units — the CIE limit is a statement about fractions at GIVEN T)."""
+        import jax.numpy as jnp
+
+        from sphexa_tpu.physics import primordial as pn
+
+        cfg = self._cfg()
+        chem = self._neutral(4)
+        rho_a = jnp.full(4, rho, jnp.float32)
+        T_a = jnp.full(4, T, jnp.float32)
+        chem = pn.relax_to_equilibrium(T_a, rho_a, chem, cfg,
+                                       dt_sub=0.02, steps=4096)
+        return chem, cfg
+
+    def test_equilibrium_matches_analytic_cie(self):
+        """The relaxed network must sit on the analytic CIE balance
+        (y_HII/y_HI = k1/k2 etc.) across the ionization range."""
+        import numpy as np
+
+        from sphexa_tpu.physics import primordial as pn
+
+        for T in (2.0e4, 6.0e4, 2.0e5):
+            chem, _ = self._relax(T)
+            eq = pn.equilibrium_fractions(np.float64(T), 0.76, 0.24)
+            got_hii = float(chem.hii[0])
+            want_hii = float(eq["hii"])
+            assert abs(got_hii - want_hii) < 0.05 * max(want_hii, 1e-3), (
+                T, got_hii, want_hii)
+            got_heiii = float(chem.heiii[0])           # mass fraction
+            want_heiii = float(eq["heiii"]) * 4.0      # number -> mass
+            assert abs(got_heiii - want_heiii) < 0.08 * max(want_heiii, 4e-3), (
+                T, got_heiii, want_heiii)
+
+    def test_equilibrium_cooling_recovers_cie_shape(self):
+        """Species-resolved cooling at the relaxed fractions follows the
+        canonical primordial CIE shape: line peak near 1e5 K, orders of
+        magnitude drop below 1e4 K, bremsstrahlung tail at 1e7 K."""
+        import numpy as np
+
+        from sphexa_tpu.physics import primordial as pn
+
+        def rate(T):
+            eq = pn.equilibrium_fractions(np.float64(T), 0.76, 0.24)
+            return float(pn.species_cooling24(np.float64(T), eq))
+
+        r8e3, r1e5, r1e7 = rate(8e3), rate(1.2e5), rate(1e7)
+        assert r1e5 > 30 * r8e3, (r8e3, r1e5)
+        assert r1e5 > 3 * r1e7, (r1e5, r1e7)
+        assert r1e7 > 0.0
+
+    def test_conservation_and_positivity(self):
+        """Element totals and charge balance are exact closures; a huge
+        dt must not produce negative fractions or NaNs."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from sphexa_tpu.physics import primordial as pn
+        from sphexa_tpu.physics.cooling import temp_to_u
+
+        cfg = self._cfg()
+        chem = self._neutral(8)
+        rho = jnp.full(8, 10.0, jnp.float32)
+        u = temp_to_u(jnp.full(8, 3e5, jnp.float32),
+                      chem.mean_molecular_weight(), cfg)
+        du, out = pn.evolve_primordial(1e4, rho, u, chem, cfg)
+        for a in (out.hi, out.hii, out.hei, out.heii, out.heiii, out.e):
+            arr = np.asarray(a)
+            assert np.all(np.isfinite(arr)) and np.all(arr >= 0.0)
+        np.testing.assert_allclose(
+            np.asarray(out.hi + out.hii), 0.76, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(out.hei + out.heii + out.heiii), 0.24, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(out.e),
+            np.asarray(out.hii + out.heii / 4.0 + 2.0 * out.heiii / 4.0),
+            rtol=1e-4, atol=1e-7,
+        )
+        assert np.all(np.isfinite(np.asarray(du)))
+
+    def test_propagator_evolves_species(self):
+        """std-cooling with evolve_species: the network runs inside the
+        jitted sharded-capable step and the fractions actually move
+        (cooler.cpp solve_chemistry per step)."""
+        import numpy as np
+
+        from sphexa_tpu.init import init_evrard
+        from sphexa_tpu.physics.cooling import ChemistryData
+        from sphexa_tpu.propagator import step_hydro_std_cooling
+        from sphexa_tpu.simulation import make_propagator_config
+
+        state, box, const = init_evrard(10)
+        cfg = make_propagator_config(state, box, const)
+        ccfg = self._cfg(gamma=const.gamma)
+        chem = ChemistryData.ionized(state.n, metallicity=0.0)
+        s, b, _, chem1 = step_hydro_std_cooling(state, box, cfg, None,
+                                                chem, ccfg)
+        _, _, d2, chem2 = step_hydro_std_cooling(s, b, cfg, None, chem1,
+                                                 ccfg)
+        hi1 = np.asarray(chem2.hi)
+        assert np.all(np.isfinite(hi1))
+        # recombination out of the fully-ionized IC must move HI off zero
+        assert float(np.max(hi1)) > 0.0
+        np.testing.assert_allclose(np.asarray(chem2.hi + chem2.hii),
+                                   0.76, rtol=1e-4)
+        assert float(d2["dt"]) > 0.0
+
+    def test_checkpoint_round_trip_evolved(self):
+        """Evolved fractions survive the snapshot field round-trip
+        (std_hydro_grackle.hpp:89-106 contract)."""
+        import numpy as np
+
+        from sphexa_tpu.physics.cooling import (
+            chemistry_from_fields, chemistry_to_fields,
+        )
+
+        chem, _ = self._relax(6.0e4)
+        back = chemistry_from_fields(chemistry_to_fields(chem))
+        for f in ("hi", "hii", "hei", "heii", "heiii", "e", "metal"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(chem, f)), np.asarray(getattr(back, f)))
