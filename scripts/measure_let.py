@@ -1,0 +1,94 @@
+"""Measure the per-shard essential-set (LET) reduction vs the replicated
+tree (VERDICT r4 #5 'Done' gate): |E_k| / num_nodes at 1M and 4M on 8
+and 16 shards — the classification work and list-sort sizes each shard
+carries under GravityConfig.let_cap.
+
+Pure sizing (numpy classify, no solve): mirrors estimate_gravity_caps'
+monotone-MAC classification with the slab bbox as the target.
+
+Usage: JAX_PLATFORMS=cpu python scripts/measure_let.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+
+from sphexa_tpu.gravity.traversal import compute_multipoles
+from sphexa_tpu.gravity.tree import build_gravity_tree
+from sphexa_tpu.init.plummer import sample_plummer as plummer
+from sphexa_tpu.sfc.box import BoundaryType, Box
+from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+THETA = 0.5
+
+
+def essential_sizes(n, shards=(8, 16)):
+    x, y, z, m = plummer(n)
+    r = float(np.max(np.abs(np.stack([x, y, z])))) * 1.001
+    box = Box.create(-r, r, boundary=BoundaryType.open)
+    keys = np.asarray(compute_sfc_keys(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(z), box))
+    order = np.argsort(keys)
+    xs, ys, zs, ms = (a[order] for a in (x, y, z, m))
+    tree, meta = build_gravity_tree(keys[order], bucket_size=64)
+    num_n = meta.num_nodes
+
+    nm, com, _, _ = (np.asarray(a) for a in compute_multipoles(
+        jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(zs), jnp.asarray(ms),
+        jnp.asarray(keys[order]), tree, meta))
+    valid = nm > 0.0
+    parent = np.asarray(tree.parent)
+    lengths = np.asarray(box.lengths)
+    lo = np.asarray([box.lo[0], box.lo[1], box.lo[2]], np.float64)
+    geo_center = lo[None, :] + np.asarray(tree.center_frac) * lengths[None, :]
+    geo_size = np.asarray(tree.halfsize_frac)[:, None] * lengths[None, :]
+    l_node = 2.0 * geo_size.max(axis=1)
+    s_off = np.linalg.norm(com - geo_center, axis=1)
+    smax = np.where(valid, s_off, 0.0)
+    BIG = 1e15
+    com_lo = np.where(valid[:, None], com, BIG)
+    com_hi = np.where(valid[:, None], com, -BIG)
+    for s, e in reversed(meta.level_ranges[1:]):
+        np.maximum.at(smax, parent[s:e], smax[s:e])
+        np.minimum.at(com_lo, parent[s:e], com_lo[s:e])
+        np.maximum.at(com_hi, parent[s:e], com_hi[s:e])
+    ccenter = np.where(valid[:, None], 0.5 * (com_lo + com_hi), BIG)
+    chalf = np.where(valid[:, None],
+                     np.maximum(0.5 * (com_hi - com_lo), 0.0), 0.0)
+    mac2 = (l_node / THETA + smax) ** 2
+    self_parent = parent == np.arange(num_n)
+
+    print(f"N={n}  nodes={num_n}  leaves={meta.num_leaves}")
+    for P in shards:
+        S = n // P
+        sizes = []
+        for k in range(P):
+            sl = slice(k * S, (k + 1) * S)
+            pmin = np.array([xs[sl].min(), ys[sl].min(), zs[sl].min()])
+            pmax = np.array([xs[sl].max(), ys[sl].max(), zs[sl].max()])
+            bc, bs = (pmax + pmin) / 2, (pmax - pmin) / 2
+            d = np.maximum(
+                np.abs(bc[None, :] - ccenter) - bs[None, :] - chalf, 0.0)
+            accept = valid & ((d * d).sum(axis=1) >= mac2)
+            anc = np.where(self_parent, False, accept[parent])
+            sizes.append(int((~anc).sum()))
+        sizes = np.asarray(sizes)
+        print(f"  P={P:3d}: |E_k| mean={sizes.mean():8.0f} "
+              f"max={sizes.max():8d}  vs nodes {num_n}  "
+              f"reduction x{num_n / sizes.max():.2f} (max) "
+              f"x{num_n / sizes.mean():.2f} (mean)")
+
+
+def main():
+    for n in (1_000_000, 4_000_000):
+        essential_sizes(n)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Bound the Sedov L1_rho gap vs the reference CI (0.166 repo vs 0.138
+reference, .jenkins/reframe_ci.py:352) — VERDICT r4 #7.
+
+The ICs are ALREADY matched (init_sedov uses the reference's regular
+grid, grid.hpp:90-130 layout; no jitter), so the candidate contributions
+are (a) the min-h symmetric pair cutoff (sym_pairs, default on — a
+deliberate deviation from momentum_energy_kern.hpp) and (b) f32 vs the
+reference's f64 coordinates/accumulations.
+
+Runs the reference config (sedov 50^3, 200 steps) in up to three
+flavors and prints each L1:
+  default      : sym_pairs on, f32 (the pinned number)
+  refparity    : sym_pairs off, f32 (isolates the convention)
+  f64          : sym_pairs off, x64 enabled (CPU; isolates precision —
+                 pass --f64 to run it, it is minutes-slow off-TPU)
+
+Usage: python scripts/probe_l1_gap.py [--f64]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_one(tag, sym_pairs, **sim_kw):
+    import dataclasses
+
+    from sphexa_tpu.analysis.compare import compute_output_fields, l1_error
+    from sphexa_tpu.analysis.sedov import sedov_solution
+    from sphexa_tpu.init import init_sedov
+    from sphexa_tpu.simulation import Simulation
+
+    state, box, const = init_sedov(50)
+    const = dataclasses.replace(const, sym_pairs=sym_pairs)
+    sim = Simulation(state, box, const, prop="std", block=8192,
+                     check_every=10, **sim_kw)
+    t0 = time.perf_counter()
+    for _ in range(200):
+        sim.step()
+    sim.flush()
+    fields = compute_output_fields(sim.state, sim.box, sim._cfg)
+    t = float(sim.state.ttot)
+    sol = sedov_solution(fields["r"], time=t, eblast=1.0,
+                         gamma=sim.const.gamma)
+    l1 = l1_error(fields["rho"], sol["rho"])
+    print(f"{tag:10s}: L1_rho = {l1:.4f}   (t={t:.4e}, "
+          f"{time.perf_counter()-t0:.0f}s)", flush=True)
+    return l1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--f64", action="store_true")
+    args = ap.parse_args()
+    if args.f64:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        # f64 run: the XLA backend path (engine kernels + persistent
+        # lists are f32-only)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        run_one("f64-ref", sym_pairs=False, backend="xla",
+                use_lists=False)
+        return
+    run_one("default", sym_pairs=True)
+    run_one("refparity", sym_pairs=False)
+
+
+if __name__ == "__main__":
+    main()

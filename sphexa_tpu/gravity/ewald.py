@@ -258,9 +258,10 @@ def compute_gravity_ewald(
     diag0 = {
         "m2p_max": jnp.int32(0), "p2p_max": jnp.int32(0),
         "leaf_occ": jnp.int32(0),
-        # the superblock candidate high-water must survive the replica
-        # scan or the Simulation's super_cap overflow guard cannot fire
+        # the superblock / LET candidate high-waters must survive the
+        # replica scan or the Simulation's cap overflow guards cannot fire
         "c_max": jnp.int32(0),
+        "let_max": jnp.int32(0),
     }
     (ax, ay, az, phi, diag), _ = jax.lax.scan(
         body, (zeros, zeros, zeros, zeros, diag0), (shifts, is_base)

@@ -66,6 +66,16 @@ class GravityConfig:
     # (>= ~1e5 nodes) where C << num_n makes the refinement pay.
     super_factor: int = 0
     super_cap: int = 1024
+    # LET analog (focused-octree role, octree_focus_mpi.hpp:50-698): on
+    # SHARDED solves, classify each shard's blocks against the shard's
+    # ESSENTIAL node set — the ancestor-closed open set + accepted cut
+    # of the slab bbox — instead of the full replicated tree. Remote
+    # regions appear only as their MAC-coarsened cut, so per-shard MAC
+    # work and list sorts scale with the slab's essential tree
+    # (O((N/P)^(2/3) + cut)), not num_nodes. 0 = off; sized by
+    # estimate_gravity_caps(let_shards=P). The slab bbox is recomputed
+    # every solve from the live positions, so the set is never stale.
+    let_cap: int = 0
     # near-field engine: stream the P2P leaf ranges through the pallas
     # pair engine (sph/pallas_pairs.py) instead of XLA gathers — the
     # dominant cost of the XLA formulation at 1e5+ particles. Set by the
@@ -97,6 +107,7 @@ def estimate_gravity_caps(
     x, y, z, m, sorted_keys, box: Box,
     tree: GravityTree, meta: GravityTreeMeta, cfg: GravityConfig,
     sample_blocks: int = 256, margin: float = 1.5, quantum: int = 32,
+    let_shards: int = 0,
 ) -> GravityConfig:
     """Size the interaction-list caps from the current distribution.
 
@@ -199,6 +210,16 @@ def estimate_gravity_caps(
                               min((b + 1) * cfg.super_factor, nb))
             c_cap_max = max(c_cap_max, int((~anc).sum()))
 
+    # per-SHARD essential-set high water (the LET cap): ~anc of the
+    # slab bbox — each shard's blocks span a contiguous block range
+    let_max = 0
+    if let_shards > 1:
+        for k in range(let_shards):
+            b0 = k * nb // let_shards
+            b1 = max(b0 + 1, (k + 1) * nb // let_shards)
+            _, anc = classify(b0, min(b1, nb))
+            let_max = max(let_max, int((~anc).sum()))
+
     def pad(v):
         return int(np.ceil(v * margin / quantum) * quantum)
 
@@ -213,6 +234,10 @@ def estimate_gravity_caps(
         super_cap=(
             min(pad(c_cap_max), meta.num_nodes)
             if cfg.super_factor > 0 else cfg.super_cap
+        ),
+        let_cap=(
+            min(pad(let_max), meta.num_nodes)
+            if let_shards > 1 else cfg.let_cap
         ),
     )
 
@@ -570,7 +595,39 @@ def compute_gravity(
         )
         return jnp.sum(d * d, axis=1) >= m2
 
+    def _compact_candidates(cand, cap):
+        """(cidx, cok, ppos) fixed-cap candidate list from a bool node
+        mask: stable compaction (level-major order preserved, so the
+        kept prefix is ancestor-closed whenever ``cand`` is), num_n
+        sentinel on dead slots keeps the list ascending for the
+        parent-position searchsorted, ppos clamped into the list."""
+        ordc = jnp.argsort(~cand, stable=True)[:cap]
+        cok = cand[ordc]
+        cidx = jnp.where(cok, ordc, num_n).astype(jnp.int32)
+        ppos = jnp.searchsorted(
+            cidx, tree.parent[jnp.minimum(cidx, num_n - 1)]
+        ).astype(jnp.int32)
+        return cidx, cok, jnp.minimum(ppos, cap - 1)
+
     sf = cfg.super_factor
+    use_let = shard is not None and cfg.let_cap > 0 and sf == 0
+    if use_let:
+        # per-shard essential node set (focused-octree / LET analog,
+        # octree_focus_mpi.hpp:50-698): ONE slab-bbox classification
+        # shared by every block of this shard. Monotone MAC => the open
+        # set + accepted cut is ancestor-closed, and any node outside it
+        # has an accepted ancestor INSIDE it for every block (block
+        # bboxes are subsets of the slab bbox computed from the same
+        # live positions, so the superblock containment argument applies
+        # with zero staleness).
+        ecap = min(cfg.let_cap, num_n)
+        bc_s, bs_s = _bbox(x + shift[0], y + shift[1], z + shift[2])
+        accept_s = valid & _accept(bc_s, bs_s, ccenter, chalf, mac2)
+        anc_s = jnp.where(self_parent, False, accept_s[tree.parent])
+        cand_s = ~anc_s
+        lidx_, lok, lpar = _compact_candidates(cand_s, ecap)
+        let_n = jnp.sum(cand_s)
+
     if sf > 0:
         # superblock pre-pass (the two-level hierarchical classification):
         # classify a ~sf*blk-particle bbox against ALL nodes once, keep
@@ -592,13 +649,7 @@ def compute_gravity(
             # monotone MAC: an accepted strict ancestor == accepted parent
             anc = jnp.where(self_parent, False, accept[tree.parent])
             cand = ~anc  # open nodes + the accepted cut (ancestor-closed)
-            ordc = jnp.argsort(~cand, stable=True)[:scap]
-            cok = cand[ordc]
-            # invalid slots -> num_n sentinel keeps the list ascending for
-            # the parent-position searchsorted
-            cidx = jnp.where(cok, ordc, num_n).astype(jnp.int32)
-            ppos = jnp.searchsorted(cidx, tree.parent[jnp.minimum(cidx, num_n - 1)]).astype(jnp.int32)
-            ppos = jnp.minimum(ppos, scap - 1)
+            cidx, cok, ppos = _compact_candidates(cand, scap)
             return cidx, cok, ppos, jnp.sum(cand)
 
         nsc = -(-num_super // chunk)
@@ -619,11 +670,17 @@ def compute_gravity(
         tx, ty, tz, th = x[bi] + shift[0], y[bi] + shift[1], z[bi] + shift[2], h[bi]
         bc, bs = _bbox(tx, ty, tz)
 
-        if sf > 0:
-            sid = bnum // sf
-            cidx = jnp.minimum(scand[sid], num_n - 1)
-            cok = scand_ok[sid]
-            ppos = spar[sid]
+        if sf > 0 or use_let:
+            if sf > 0:
+                sid = bnum // sf
+                cidx = jnp.minimum(scand[sid], num_n - 1)
+                cok = scand_ok[sid]
+                ppos = spar[sid]
+            else:
+                # LET: the shard-wide essential list, shared by blocks
+                cidx = jnp.minimum(lidx_, num_n - 1)
+                cok = lok
+                ppos = lpar
             accept = cok & valid[cidx] & _accept(
                 bc, bs, ccenter[cidx], chalf[cidx], mac2[cidx]
             )
@@ -783,6 +840,8 @@ def compute_gravity(
     # nodes (pre-pass) + blocks x super_cap (refinement)
     if sf > 0:
         evals = num_super * num_n + num_blocks * scap
+    elif use_let:
+        evals = num_n + num_blocks * ecap
     else:
         evals = num_blocks * num_n
     # phantom tail blocks (chunk padding re-evaluates the last particle as
@@ -809,6 +868,8 @@ def compute_gravity(
         "leaf_occ": leaf_occ,
         # superblock candidate-list high water (cap guard; 0 = dense path)
         "c_max": c_max if sf > 0 else jnp.int32(0),
+        # per-shard essential-set high water (LET cap guard; 0 = off)
+        "let_max": let_n if use_let else jnp.int32(0),
         # accepted-to-evaluated MAC work (VERDICT r2 #4 diagnostic): the
         # hierarchical path shrinks the denominator by ~num_n/super_cap
         "mac_work_ratio": (

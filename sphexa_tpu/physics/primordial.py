@@ -136,6 +136,25 @@ def species_cooling24(T, y):
     )
 
 
+def metal_cooling24(T, metal, cfg, x_h: float = 0.76):
+    """Metal-line cooling on top of the primordial network — the
+    GRACKLE decomposition (primordial network + Cloudy metal table,
+    cooler.cpp metal_cooling flag): the metal channel is the RESIDUAL
+    of the solar-metallicity CIE table over the primordial network's
+    own equilibrium cooling at the same T, scaled linearly in the
+    particle's metal mass fraction. Returns the lam24-normalized rate
+    per (rho/m_H)^2 (the same units species_cooling24 uses)."""
+    from sphexa_tpu.physics.cooling import _log_lambda_cie
+
+    # table rate is per n_H^2 = (x_h rho/m_H)^2; convert to per
+    # (rho/m_H)^2 with x_h^2
+    lam_cie24 = 10.0 ** (_log_lambda_cie(T, cfg) + 24.0) * x_h**2
+    eq = equilibrium_fractions(T, x_h, 1.0 - x_h)
+    lam_prim24 = species_cooling24(T, eq)
+    Z_SUN = 0.0122
+    return jnp.maximum(lam_cie24 - lam_prim24, 0.0) * (metal / Z_SUN)
+
+
 def equilibrium_fractions(T, x_h, x_he):
     """Analytic CIE ionization balance at temperature T: the fixed point
     the subcycled network must relax to (rate ratios only — density
@@ -243,10 +262,12 @@ def evolve_primordial(dt, rho_code, u_code, chem: ChemistryData,
     Per subcycle (cooler.cpp solve_chemistry structure, jit-shaped):
     T from (u, mu) -> rates -> sequential semi-implicit species updates
     with exact closure (HII = X - HI; HeIII = Y/4 - HeI - HeII;
-    e from charge balance) -> species-resolved cooling -> positivity-
-    preserving implicit u update. Returns (du_avg, new ChemistryData);
-    metal fraction passes through (the network is primordial, the metal
-    channel stays tabulated in the caller when enabled).
+    e from charge balance) -> species-resolved + metal-residual cooling
+    (metal_cooling24: the CIE-table residual over the network's own
+    equilibrium, scaled by the particle's metal fraction — the GRACKLE
+    network+metal-table decomposition) -> positivity-preserving
+    implicit u update. Returns (du_avg, new ChemistryData); the metal
+    FRACTION itself passes through unevolved.
     """
     r0, c0 = _prefactors(cfg)
     sub = cfg.substeps
@@ -264,7 +285,9 @@ def evolve_primordial(dt, rho_code, u_code, chem: ChemistryData,
         y_new = _species_update(y, T, a, x_h, y_he_tot)
 
         # species-resolved cooling, implicit positivity-preserving in u
-        cool = rho_code * c0 * species_cooling24(T, y_new)
+        cool = rho_code * c0 * (
+            species_cooling24(T, y_new) + metal_cooling24(T, metal, cfg)
+        )
         heat = cfg.heating_code
         u_new = (u / (1.0 + dt_sub * cool / jnp.maximum(u, 1e-30))
                  + dt_sub * heat)
@@ -289,6 +312,8 @@ def primordial_cooling_timestep(rho_code, u_code, chem: ChemistryData,
     y = _y_of(chem)
     mu = _mu_of_y(y, chem.metal)
     T = jnp.maximum(u_to_temp(u_code, mu, cfg), 10.0)
-    dudt = rho_code * c0 * species_cooling24(T, y) - cfg.heating_code
+    dudt = (rho_code * c0 * (species_cooling24(T, y)
+                             + metal_cooling24(T, chem.metal, cfg))
+            - cfg.heating_code)
     tc = jnp.abs(u_code / jnp.where(jnp.abs(dudt) > 0, dudt, 1e-30))
     return cfg.ct_crit * jnp.min(tc)

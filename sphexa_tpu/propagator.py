@@ -184,7 +184,8 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
             return gx, gy, gz, egrav, diag
 
         dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
-                 "leaf_occ": PartitionSpec(), "c_max": PartitionSpec()}
+                 "leaf_occ": PartitionSpec(), "c_max": PartitionSpec(),
+                 "let_max": PartitionSpec()}
     else:
 
         def stage(box, keys, x, y, z, m, h):
@@ -202,6 +203,7 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
 
         dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
                  "leaf_occ": PartitionSpec(), "c_max": PartitionSpec(),
+                 "let_max": PartitionSpec(),
                  "mac_work_ratio": PartitionSpec()}
 
     Pp, Pr = PartitionSpec(axis), PartitionSpec()

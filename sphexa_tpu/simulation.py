@@ -477,6 +477,9 @@ class Simulation:
                           else 32,
                           use_pallas=self._cfg.backend == "pallas"),
             margin=margin,
+            # sharded solves classify against the per-shard essential
+            # node set (LET analog) instead of the full replicated tree
+            let_shards=self._mesh.size if self._mesh is not None else 0,
         )
         self._gtree = gtree
         ewald = None
@@ -503,6 +506,8 @@ class Simulation:
             or int(diagnostics["p2p_max"]) > g.p2p_cap
             or int(diagnostics["leaf_occ"]) > g.leaf_cap
             or int(diagnostics.get("c_max", 0)) > g.super_cap
+            or (g.let_cap > 0
+                and int(diagnostics.get("let_max", 0)) > g.let_cap)
         )
 
     def _config_still_valid(self, diagnostics) -> bool:

@@ -336,3 +336,23 @@ class TestPrimordialNetwork:
         for f in ("hi", "hii", "hei", "heii", "heiii", "e", "metal"):
             np.testing.assert_array_equal(
                 np.asarray(getattr(chem, f)), np.asarray(getattr(back, f)))
+
+    def test_metal_channel_residual(self):
+        """Metal-line cooling in evolve mode: the CIE-table residual over
+        the network's equilibrium, linear in Z (the GRACKLE network +
+        metal-table decomposition) — present at solar Z, zero at Z=0,
+        and strongest in the metal-line band (~2e5 K)."""
+        import numpy as np
+
+        from sphexa_tpu.physics import primordial as pn
+
+        cfg = self._cfg()
+        z_sun = 0.0122
+        at = lambda T, z: float(pn.metal_cooling24(
+            np.float64(T), np.float64(z), cfg))
+        assert at(2e5, 0.0) == 0.0
+        assert at(2e5, z_sun) > 0.0
+        np.testing.assert_allclose(at(2e5, z_sun / 2), at(2e5, z_sun) / 2,
+                                   rtol=1e-6)
+        # metal lines dominate the band between the H/He peak and brems
+        assert at(2e5, z_sun) > at(2e7, z_sun)

@@ -69,3 +69,50 @@ def test_flush_idempotent_and_deferred_flag():
     d2 = sim.flush()
     assert "deferred" not in d2 or d2.get("deferred") != 1.0
     assert sim.flush() is d2 or sim.flush() == d2  # nothing pending
+
+
+def test_deferred_h_outgrows_cell_mid_window():
+    """VERDICT r4 weak #7 pin: under check_every > 1 the 2h-vs-cell-edge
+    freshness check only runs at flush — so a smoothing length that has
+    outgrown the configured search window can run up to check_every
+    unchecked steps. The in-step window_ok guard must encode that as the
+    occupancy sentinel, and flush must roll the whole window back and
+    replay it through the checked path (which reconfigures first), ending
+    in the same state a synchronous run from the same ICs produces."""
+    import jax.numpy as jnp
+
+    # 32^3: the grid has window < ncell — a 4x h growth genuinely cannot
+    # be covered by the configured window (a tiny grid would fall into
+    # the fold-mode escape hatch, which handles any h correctly and
+    # defeats the point of the test)
+    state, box, const = init_sedov(32)
+    sim = Simulation(state, box, const, prop="std", block=4096,
+                     check_every=4)
+    assert sim._cfg.nbr.window < (1 << sim._cfg.nbr.level)
+    # h outgrows the cell grid AFTER configuration, BEFORE the window:
+    # every deferred step runs with a too-small search window
+    sim.state = dataclasses.replace(
+        sim.state, h=jnp.asarray(sim.state.h) * 4.0
+    )
+    for _ in range(3):
+        d = sim.step()      # stale steps run unchecked (happy path)
+        assert d.get("deferred") == 1.0
+    d = sim.step()          # 4th step drains the window: detect + replay
+    assert d["reconfigured"] == 1.0
+    assert sim.iteration == 4
+    assert int(d["occupancy"]) <= sim._cfg.nbr.cap
+    assert np.all(np.isfinite(np.asarray(sim.state.x)))
+
+    # equivalence: a synchronous run whose config was sized for the
+    # grown h from the start
+    gstate = dataclasses.replace(state, h=jnp.asarray(state.h) * 4.0)
+    ref = Simulation(gstate, box, const, prop="std", block=4096)
+    for _ in range(4):
+        ref.step()
+    np.testing.assert_allclose(
+        np.asarray(sim.state.x), np.asarray(ref.state.x),
+        rtol=1e-6, atol=1e-8,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sim.state.temp), np.asarray(ref.state.temp), rtol=1e-5
+    )
