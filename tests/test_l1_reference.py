@@ -7,8 +7,12 @@ Reference values and what they mean:
 
 - Sedov L1_rho = 0.138 +-1.5% (1x P100). This is a true like-for-like
   metric (sim rho vs analytic rho at each particle radius). We measure
-  0.166 at the same config (f32 coordinates, jittered-lattice IC instead
-  of the reference grid/glass) and pin a window around that.
+  0.166 at the same config and pin a window around that. The gap vs the
+  reference's 0.138 is NOT IC (init_sedov uses the same regularGrid
+  layout), NOT the pair-cutoff convention (sym_pairs off: 0.1665) and
+  NOT precision (full f64: 0.1663) — each bounded <0.2% by
+  scripts/probe_l1_gap.py (BASELINE.md round-5 notes); the residual is
+  a formulation/metric-convention difference.
   NOTE the reference's published "L1_p = 0.902" and "L1_vel = 0.915"
   compare p and |v| against the analytic DENSITY curve
   (compare_solutions.py:115,126 passes solution["rho"] as ySol) — they
