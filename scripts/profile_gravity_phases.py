@@ -79,7 +79,22 @@ def main():
     geo_size = gtree.halfsize_frac[:, None] * lengths[None, :]
     l_node = 2.0 * jnp.max(geo_size, axis=1)
     s_off = jnp.sqrt(jnp.sum((node_com - geo_center) ** 2, axis=1))
-    mac2 = (l_node / cfg.theta + s_off) ** 2
+    # monotone MAC preamble (mirrors compute_gravity)
+    smax = jnp.where(valid, s_off, 0.0)
+    BIG = jnp.float32(1e15)
+    com_lo = jnp.where(valid[:, None], node_com, BIG)
+    com_hi = jnp.where(valid[:, None], node_com, -BIG)
+    for s_, e_ in reversed(meta.level_ranges[1:]):
+        par_ = gtree.parent[s_:e_]
+        smax = smax.at[par_].max(smax[s_:e_])
+        com_lo = com_lo.at[par_].min(com_lo[s_:e_])
+        com_hi = com_hi.at[par_].max(com_hi[s_:e_])
+    ccenter = jnp.where(valid[:, None], 0.5 * (com_lo + com_hi), BIG)
+    chalf = jnp.where(valid[:, None],
+                      jnp.maximum(0.5 * (com_hi - com_lo), 0.0), 0.0)
+    mac2 = (l_node / cfg.theta + smax) ** 2
+    self_parent = gtree.parent == jnp.arange(num_n,
+                                             dtype=gtree.parent.dtype)
 
     blk = cfg.target_block
     num_blocks = -(-N // blk)
@@ -101,28 +116,29 @@ def main():
                         (jnp.max(tz) - jnp.min(tz)) * 0.5])
         return bc, bs
 
-    def _accept(bc, bs, com, m2):
-        d = jnp.maximum(jnp.abs(bc[None, :] - com) - bs[None, :], 0.0)
+    def _accept(bc, bs, gc, gs, m2):
+        d = jnp.maximum(jnp.abs(bc[None, :] - gc) - bs[None, :] - gs, 0.0)
         return jnp.sum(d * d, axis=1) >= m2
 
     def block_phase(bi, phase):
         tx, ty, tz = x_[bi], y_[bi], z_[bi]
         bc, bs = _bbox(tx, ty, tz)
-        accept = valid & _accept(bc, bs, node_com, mac2)
+        accept = valid & _accept(bc, bs, ccenter, chalf, mac2)
         if phase == 1:
             return jnp.sum(accept)
-        anc = jnp.zeros(num_n, dtype=bool)
-        for s, e in meta.level_ranges[1:]:
-            par = gtree.parent[s:e]
-            anc = anc.at[s:e].set(anc[par] | accept[par])
+        # monotone MAC: one parent gather replaces the level downsweep
+        anc = jnp.where(self_parent, False, accept[gtree.parent])
         m2p_mask = accept & ~anc
-        p2p_mask = gtree.is_leaf & valid & ~accept & ~anc
+        p2p_mask = gtree.is_leaf & valid & ~accept
         if phase == 2:
             return jnp.sum(m2p_mask) + jnp.sum(p2p_mask)
         m2p_n = jnp.sum(m2p_mask)
         cls = jnp.where(m2p_mask, 0, jnp.where(p2p_mask, 1, 2))
-        order_all = jnp.argsort(cls.astype(jnp.int32), stable=True)
-        cls_sorted = jnp.sort(cls.astype(jnp.int32), stable=True)
+        nbits = max(1, int(np.ceil(np.log2(max(num_n, 2)))))
+        iota_k = jnp.arange(num_n, dtype=jnp.int32)
+        ks = jnp.sort((cls.astype(jnp.int32) << nbits) | iota_k)
+        order_all = ks & jnp.int32((1 << nbits) - 1)
+        cls_sorted = ks >> nbits
         padn = max(cfg.m2p_cap, cfg.p2p_cap)
         order_all = jnp.concatenate(
             [order_all, jnp.full((padn,), num_n - 1, order_all.dtype)])
