@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="force an N-virtual-device CPU mesh for --devices "
                         "runs on hosts with fewer real chips (validation "
                         "mode; same mechanism as the multi-chip dry run)")
+    p.add_argument("--halo-mode", default="sparse",
+                   choices=("sparse", "windowed"), dest="halo_mode",
+                   help="multi-chip halo exchange: sparse cell-granular "
+                        "per-distance buffers (default) or contiguous "
+                        "per-peer windows")
     p.add_argument("--insitu", default=None,
                    help="in-situ rendering per iteration: slice | projection "
                         "(the Ascent/Catalyst adaptor role, ascent_adaptor.h)")
@@ -248,7 +253,7 @@ def main(argv=None) -> int:
                          turb_state=turb_state, turb_cfg=turb_cfg,
                          chem=chem_restored, cooling_cfg=cooling_cfg,
                          keep_fields=observable.needs_fields, theta=args.theta,
-                         num_devices=args.devices)
+                         num_devices=args.devices, halo_mode=args.halo_mode)
     except (NotImplementedError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2

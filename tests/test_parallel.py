@@ -923,3 +923,44 @@ class TestSparseHaloExchange:
             np.asarray(out_state.temp), np.asarray(ref_state.temp),
             rtol=1e-3, atol=1e-6,
         )
+
+
+class TestShardedEvolvedChemistry:
+    """VERDICT r4 #6 'Done' gate: the 6-species network evolves INSIDE
+    the sharded std-cooling step (cooler.cpp solve_chemistry under the
+    full domain) and matches the single-device run."""
+
+    def test_sharded_evolved_species_match_single(self):
+        from sphexa_tpu.physics.cooling import ChemistryData, CoolingConfig
+        from sphexa_tpu.propagator import step_hydro_std_cooling
+
+        state, box, const = init_sedov(16)
+        ccfg = CoolingConfig(gamma=const.gamma, evolve_species=True)
+        chem = ChemistryData.ionized(state.n)
+        cfg = make_propagator_config(state, box, const, block=512,
+                                     backend="pallas")
+        ref_state, _, _, ref_chem = step_hydro_std_cooling(
+            state, box, cfg, None, chem, ccfg
+        )
+        # the network actually moved the fractions off the ionized IC
+        assert float(jnp.max(jnp.abs(ref_chem.hi - chem.hi))) > 0.0
+
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        schem = shard_state(chem, mesh)
+        step = make_sharded_step(mesh, cfg, step_fn=step_hydro_std_cooling,
+                                 aux_cfg=ccfg)
+        out_state, _, _, out_chem = step(sstate, box, None, schem)
+        assert out_chem.hi.sharding.spec == jax.sharding.PartitionSpec("p")
+        np.testing.assert_allclose(
+            np.asarray(out_chem.hi), np.asarray(ref_chem.hi),
+            rtol=1e-5, atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_chem.e), np.asarray(ref_chem.e),
+            rtol=1e-5, atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_state.temp), np.asarray(ref_state.temp),
+            rtol=1e-4, atol=1e-7,
+        )
