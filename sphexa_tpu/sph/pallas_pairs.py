@@ -1501,7 +1501,7 @@ def pallas_iad_divv_curlv(
     c11, c12, c13, c22, c23, c33,
     sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, with_gradv: bool = False, interpret: bool = False,
-    jdata=None, i_offset=0, lists=None, list_walk: bool = False,
+    jdata=None, i_offset=0, lists=None, list_walk=None,
 ):
     """Velocity divergence/curl through the IAD gradient
     (divv_curlv_kern.hpp:43-120), optionally the full symmetrized
@@ -1584,10 +1584,13 @@ def pallas_iad_divv_curlv(
     jf = jdata or (x, y, z, xm, vx, vy, vz)
     f = lambda a: a.reshape(-1)[:n]
     if lists is not None:
+        if list_walk is None:
+            # measured at 80^3: divv/curlv body is a WASH vs chunk-skip
+            # (59.1 vs 58.2 ms) but the 9-accumulator gradv (avClean)
+            # body pays for lane compaction (60.3 vs 71.3 ms) — default
+            # per body weight
+            list_walk = with_gradv
         if list_walk:
-            # measured a WASH vs chunk-skip at 80^3 (59.1 vs 58.2 ms,
-            # scripts/bench_lists.py --ve) — skip stays the default;
-            # the walk path is kept selectable for heavier-body variants
             engine = group_pair_engine_lists(
                 pair_body, finalize, num_i=15, num_j=7,
                 num_acc=9 if with_gradv else 4, cfg=cfg,
