@@ -60,13 +60,21 @@ def _gravity_scale_line(n=1_000_000):
     """Gravity-only throughput at 1M (Plummer, theta=0.5, ~58k-node
     tree): the scale where the dense MAC classification cost matters.
     Standalone solve (no hydro) so the line isolates the tree walk the
-    reference benches as its nbody path."""
+    reference benches as its nbody path. The solver shape comes from
+    gravity_tuning — the SAME choice Simulation makes — so on TPU this
+    line exercises the hierarchical bitmask compaction; the "extra" block
+    carries a phase breakdown (multipoles / solve, plus the sort-mode
+    solve for comparison when the tuned mode differs) and the compaction
+    complexity proxy (compact_width: candidate slots per block's list
+    materialization — num_nodes for the flat sort, super_cap for the
+    hierarchical kernel)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
 
     from sphexa_tpu.gravity.traversal import (
-        GravityConfig, compute_gravity, estimate_gravity_caps)
+        GravityConfig, compute_gravity, compute_multipoles,
+        estimate_gravity_caps, gravity_tuning)
     from sphexa_tpu.gravity.tree import build_gravity_tree
     from sphexa_tpu.init.plummer import sample_plummer
     from sphexa_tpu.sfc.box import BoundaryType, Box
@@ -83,30 +91,65 @@ def _gravity_scale_line(n=1_000_000):
     gtree, meta = build_gravity_tree(keys[order], bucket_size=64)
     cfg = estimate_gravity_caps(
         xs, ys, zs, ms, skeys, box, gtree, meta,
-        GravityConfig(theta=0.5, bucket_size=64, G=1.0, target_block=256,
-                      blocks_per_chunk=8,
-                      use_pallas=jax.default_backend() == "tpu"),
+        GravityConfig(theta=0.5, bucket_size=64, G=1.0,
+                      **gravity_tuning(n, jax.default_backend() == "tpu")),
         margin=1.6)
     hs = jnp.full_like(xs, 1e-3)
     args = (xs, ys, zs, ms, hs, skeys, box, gtree, meta)
-    out = compute_gravity(*args, cfg)
-    jax.block_until_ready(out)
-    out = compute_gravity(*args, cfg)  # discard post-compile outlier
-    jax.block_until_ready(out)
-    _ = float(out[3])
-    best = 1e9
-    for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(2):
-            out = compute_gravity(*args, cfg)
+
+    def timed_solve(c):
+        out = compute_gravity(*args, c)
+        jax.block_until_ready(out)
+        out = compute_gravity(*args, c)  # discard post-compile outlier
         jax.block_until_ready(out)
         _ = float(out[3])
-        best = min(best, (time.perf_counter() - t0) / 2)
+        best = 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(2):
+                out = compute_gravity(*args, c)
+            jax.block_until_ready(out)
+            _ = float(out[3])
+            best = min(best, (time.perf_counter() - t0) / 2)
+        return best, out
+
+    best, out = timed_solve(cfg)
+    diag = out[4]
+
+    # phase breakdown for the JSON extra block: the two headline terms
+    # (shared multipole upsweep vs the classification+lists+eval solve),
+    # and the flat-sort solve when the tuned compaction differs — the
+    # direct before/after of the bitmask change on this hardware
+    # discard the first standalone call: compute_multipoles has only run
+    # INLINED inside compute_gravity's jit so far, and its top-level jit
+    # compile would otherwise dominate the phase number
+    mpc = compute_multipoles(xs, ys, zs, ms, skeys, gtree, meta)
+    jax.block_until_ready(mpc)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        mpc = compute_multipoles(xs, ys, zs, ms, skeys, gtree, meta)
+    jax.block_until_ready(mpc)
+    t_mp = (time.perf_counter() - t0) / 3
+    phases = {
+        "multipoles_ms": round(t_mp * 1e3, 1),
+        "solve_ms": round(best * 1e3, 1),
+        "compaction": cfg.compaction,
+        "super_factor": cfg.super_factor,
+        "compact_width": int(diag["compact_width"]),
+        "mac_work_ratio": round(float(diag["mac_work_ratio"]), 5),
+    }
+    if cfg.compaction != "sort":
+        import dataclasses
+
+        t_sort, _ = timed_solve(dataclasses.replace(
+            cfg, compaction="sort", super_factor=0))
+        phases["solve_sort_ms"] = round(t_sort * 1e3, 1)
     return {
         "gravity_1m_updates_per_sec": round(n / best, 1),
         "gravity_1m_nodes": int(meta.num_nodes),
         "gravity_1m_vs_baseline": round(
             n / best / BASELINE_UPDATES_PER_SEC, 4),
+        "gravity_phases": phases,
     }
 
 

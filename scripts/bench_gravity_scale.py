@@ -75,10 +75,15 @@ def main():
 
     args = (xs, ys, zs, ms, hs, skeys, box, gtree, meta)
     results = {}
+    compaction = os.environ.get("COMPACT", "sort")  # sort | bitmask
+    # hierarchical pre-pass factor: the SAME env name the sibling
+    # profile_gravity_phases.py reads; 0 keeps the flat sweep
+    sf_env = SUPER if compaction == "bitmask" else 0
     for tb in (64, 128, 256, 512):
         base = GravityConfig(theta=THETA, bucket_size=BUCKET, G=1.0,
                              target_block=tb,
                              blocks_per_chunk=max(4, 2048 // tb),
+                             compaction=compaction, super_factor=sf_env,
                              use_pallas=jax.default_backend() == "tpu")
         cfg0 = estimate_gravity_caps(xs, ys, zs, ms, skeys, box, gtree,
                                      meta, base, margin=1.6)

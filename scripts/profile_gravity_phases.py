@@ -180,6 +180,30 @@ def main():
           f"({N/t_full/1e6:.2f}M parts/s, m2p_max={int(d['m2p_max'])} "
           f"p2p_max={int(d['p2p_max'])})")
 
+    # compaction-mode comparison (ISSUE 1): the flat per-block sort vs
+    # the bitmask-rank kernel, flat and hierarchical. compact_width is
+    # the per-block candidate width of the list materialization — the
+    # op-count/complexity proxy recorded when no chip is available
+    # (blocks x width ~ hot-path compaction work; the sort pays an extra
+    # log-factor on top of its width).
+    import dataclasses
+
+    sf = int(os.environ.get("SUPER", "8"))
+    variants = [("sort     sf=0 ", cfg)]
+    cfg_b0 = dataclasses.replace(cfg, compaction="bitmask", super_factor=0)
+    variants.append(("bitmask  sf=0 ", cfg_b0))
+    base_h = dataclasses.replace(base, compaction="bitmask", super_factor=sf)
+    cfg_h = estimate_gravity_caps(xs, ys, zs, ms, skeys, box, gtree, meta,
+                                  base_h, margin=1.6)
+    variants.append((f"bitmask  sf={sf}", cfg_h))
+    for tag, c in variants:
+        t, o = timed(jax.jit(lambda c=c: compute_gravity(
+            xs, ys, zs, ms, hs, skeys, box, gtree, meta, c, mp_cache=mpc)))
+        dd = {k: float(v) for k, v in o[4].items()}
+        print(f"solve [{tag}]: {t*1e3:8.1f} ms   compact_width="
+              f"{int(dd['compact_width'])} c_max={int(dd['c_max'])} "
+              f"m2p_max={int(dd['m2p_max'])}")
+
 
 if __name__ == "__main__":
     main()

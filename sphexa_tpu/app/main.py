@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gravity MAC accuracy parameter [0.5]")
     p.add_argument("--G", type=float, default=None, dest="grav_constant",
                    help="gravitational constant override (enables gravity)")
+    p.add_argument("--m2p-cap-margin", type=float, default=1.3,
+                   dest="m2p_cap_margin",
+                   help="gravity M2P interaction-list cap margin [1.3]; "
+                        "the M2P eval cost is linear in the cap, overflow "
+                        "is diagnostic-guarded and auto-regrown")
     p.add_argument("--sym-pairs", default=None, choices=("on", "off"),
                    dest="sym_pairs",
                    help="momentum/energy pair-cutoff convention: on = min-h "
@@ -253,6 +258,7 @@ def main(argv=None) -> int:
                          turb_state=turb_state, turb_cfg=turb_cfg,
                          chem=chem_restored, cooling_cfg=cooling_cfg,
                          keep_fields=observable.needs_fields, theta=args.theta,
+                         m2p_cap_margin=args.m2p_cap_margin,
                          num_devices=args.devices, halo_mode=args.halo_mode)
     except (NotImplementedError, ValueError) as e:
         print(str(e), file=sys.stderr)

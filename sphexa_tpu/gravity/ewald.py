@@ -262,6 +262,7 @@ def compute_gravity_ewald(
         # replica scan or the Simulation's cap overflow guards cannot fire
         "c_max": jnp.int32(0),
         "let_max": jnp.int32(0),
+        "compact_width": jnp.int32(0),
     }
     (ax, ay, az, phi, diag), _ = jax.lax.scan(
         body, (zeros, zeros, zeros, zeros, diag0), (shifts, is_base)

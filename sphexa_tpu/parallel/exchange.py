@@ -40,7 +40,12 @@ import jax.numpy as jnp
 from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
 from sphexa_tpu.sph.pallas_pairs import GroupRanges
 
-INF32 = jnp.int32(2**30)
+# numpy, NOT jnp: this module is first imported INSIDE jitted stage
+# functions, and a module-level jnp constant created under an active
+# trace is a tracer — it leaks into later traces (UnexpectedTracerError
+# in dryrun_multichip once the shard_map import shim let the pallas
+# steps run). A numpy scalar weak-types identically in every jnp op.
+INF32 = np.int32(2**30)
 
 
 def estimate_halo_window(

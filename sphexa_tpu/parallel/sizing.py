@@ -30,7 +30,10 @@ import jax.numpy as jnp
 
 from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
 
-INF32 = jnp.int32(2**30)
+# numpy, NOT jnp: lazily-imported module — a jnp constant built while a
+# trace is active would itself be a tracer and leak into later traces
+# (see parallel/exchange.py INF32)
+INF32 = np.int32(2**30)
 
 # device->host bytes moved by the sizing path since the last reset — the
 # transfer-size counter that PROVES reconfiguration is O(N/P): every fetch
@@ -63,15 +66,23 @@ def fetch(x):
 
 @functools.partial(jax.jit, static_argnames=("level", "group", "curve"))
 def sizing_stats(x, y, z, box, level: int, group: int,
-                 curve: str = "hilbert"):
+                 curve: str = "hilbert", keys=None, order=None):
     """(occ_max, ext (3,)): the per-level stats make_propagator_config
     needs beyond n and h_max (h_max must be fetched BEFORE this call —
     ``level`` is static and derives from it) — one jitted pass, four
-    scalars to the host."""
+    scalars to the host.
+
+    ``keys``/``order``: optional precomputed device keys + argsort of
+    the SAME (x, y, z, box, curve). Simulation._configure passes them
+    when self-gravity also needs keys, so the multi-device reconfigure
+    pays keygen+argsort over N ONCE (round-4 reviewer finding: this
+    helper and _configure_gravity each ran their own)."""
     from sphexa_tpu.sfc.keys import compute_sfc_keys
 
-    keys = compute_sfc_keys(x, y, z, box, curve=curve)
-    order = jnp.argsort(keys)
+    if keys is None:
+        keys = compute_sfc_keys(x, y, z, box, curve=curve)
+    if order is None:
+        order = jnp.argsort(keys)
     skeys = keys[order]
     shift = KEY_DTYPE(3 * (KEY_BITS - level))
     ncell3 = (1 << level) ** 3

@@ -35,6 +35,26 @@ from sphexa_tpu.sph.timestep import (
     rho_timestep,
 )
 
+try:  # jax >= 0.6 exports shard_map at the top level
+    from jax import shard_map as _jax_shard_map
+except ImportError:  # older jax keeps it in the experimental namespace
+    from jax.experimental.shard_map import shard_map as _jax_shard_map
+
+import inspect as _inspect
+
+_SHARD_MAP_PARAMS = frozenset(
+    _inspect.signature(_jax_shard_map).parameters
+)
+
+
+def shard_map(*args, **kwargs):
+    """Version-compat shard_map: the replication check kwarg was renamed
+    check_rep -> check_vma across jax releases; translate so the same
+    call sites run on both."""
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _jax_shard_map(*args, **kwargs)
+
 
 @dataclasses.dataclass(frozen=True)
 class PropagatorConfig:
@@ -156,7 +176,6 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
     the near field through the windowed halo exchange. Covers the open
     Barnes-Hut solve (any multipole order) and the periodic Ewald path
     (cartesian quadrupole, traversal_ewald_cpu.hpp parity)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec
     from sphexa_tpu.gravity.traversal import compute_multipoles_sharded
 
@@ -185,7 +204,8 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
 
         dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
                  "leaf_occ": PartitionSpec(), "c_max": PartitionSpec(),
-                 "let_max": PartitionSpec()}
+                 "let_max": PartitionSpec(),
+                 "compact_width": PartitionSpec()}
     else:
 
         def stage(box, keys, x, y, z, m, h):
@@ -204,6 +224,7 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
         dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
                  "leaf_occ": PartitionSpec(), "c_max": PartitionSpec(),
                  "let_max": PartitionSpec(),
+                 "compact_width": PartitionSpec(),
                  "mac_work_ratio": PartitionSpec()}
 
     Pp, Pr = PartitionSpec(axis), PartitionSpec()
@@ -317,7 +338,6 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     reference's per-stage halo choreography. Scalar guards/timesteps are
     pmax/pmin-reduced so every shard returns identical values.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec
     from sphexa_tpu.parallel import exchange as ex
     from sphexa_tpu.sph import pallas_pairs as pp
@@ -391,7 +411,6 @@ def _ve_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     the windowed all_to_all exchange, one serve round per reference halo
     epoch (xm; kx/prho/c/v; divv; alpha/gradv — ve_hydro.hpp:154-188).
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec
     from sphexa_tpu.parallel import exchange as ex
     from sphexa_tpu.sph import pallas_pairs as pp

@@ -60,6 +60,7 @@ def make_propagator_config(
     use_lists: bool = False,
     list_skin_rel: float = 0.2,
     list_slot_margin: float = 1.3,
+    sizing_cache=None,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -78,6 +79,10 @@ def make_propagator_config(
     reference's rank-local assignment, assignment.hpp:84-122). The
     default host path keeps the native C++ runtime exercised
     single-device.
+
+    ``sizing_cache``: optional precomputed (keys, order) device arrays
+    for the device_sizing path, so a caller that also needs keys (the
+    gravity reconfigure) computes them once.
     """
     if backend == "auto":
         # fused pallas kernels on TPU, portable gather path elsewhere
@@ -95,7 +100,8 @@ def make_propagator_config(
         )
         level = min(level, level_occ)
         occ, ext_d = sizing.sizing_stats(
-            state.x, state.y, state.z, box, level, group, curve
+            state.x, state.y, state.z, box, level, group, curve,
+            *(sizing_cache or (None, None))
         )
         cap = pad_cap(int(sizing.fetch(occ)))
         ext = np.asarray(sizing.fetch(ext_d))
@@ -214,6 +220,7 @@ class Simulation:
         use_lists: bool = True,
         list_skin_rel: float = 0.2,
         halo_mode: str = "sparse",
+        m2p_cap_margin: float = 1.3,
     ):
         self.state = state
         self.box = box
@@ -228,6 +235,7 @@ class Simulation:
         self.ngmax = ngmax or const.ngmax
         self.theta = theta
         self.grav_bucket = grav_bucket
+        self.m2p_cap_margin = m2p_cap_margin
         # multi-chip: shard the state over a device mesh and drive the
         # sharded step (parallel/mesh.py) through the SAME loop —
         # reconfiguration re-sizes the per-peer halo window exactly like
@@ -362,7 +370,19 @@ class Simulation:
             jax.block_until_ready(jax.tree.leaves(self.state))
         # multi-device: every sizing statistic comes from jitted device
         # reductions (O(N/P) transfers, parallel/sizing.py); single-device
-        # keeps the native C++ host sizing pass
+        # keeps the native C++ host sizing pass. When self-gravity also
+        # needs device keys, compute keygen+argsort over N ONCE here and
+        # hand it to both consumers (sizing_stats used to run its own
+        # pair — the round-4 reviewer's double-keygen finding).
+        sizing_cache = None
+        if self._mesh is not None and self.gravity_on:
+            from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+            keys_d = compute_sfc_keys(
+                self.state.x, self.state.y, self.state.z, self.box,
+                curve=self.curve,
+            )
+            sizing_cache = (keys_d, jnp.argsort(keys_d))
         self._cfg = make_propagator_config(
             self.state, self.box, self.const,
             ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
@@ -372,9 +392,10 @@ class Simulation:
             use_lists=self._lists_eligible,
             list_skin_rel=self._list_skin_rel,
             list_slot_margin=self._slot_margin,
+            sizing_cache=sizing_cache,
         )
         if self.gravity_on:
-            self._configure_gravity(grav_margin)
+            self._configure_gravity(grav_margin, keys_cache=sizing_cache)
         if self._mesh is not None:
             self._configure_sharded()
 
@@ -421,7 +442,7 @@ class Simulation:
             halo_window=wmax, halo_cells=hcells, aux_cfg=aux_cfg,
         )
 
-    def _configure_gravity(self, margin: float):
+    def _configure_gravity(self, margin: float, keys_cache=None):
         """(Re)build the gravity tree structure from the current particle
         distribution and size the interaction-list caps (the gravity analog
         of re-sizing the neighbor cell grid — reconfiguration granularity
@@ -429,20 +450,25 @@ class Simulation:
         build. Multi-device: the distributed histogram-pyramid build
         (parallel/sizing.py — the update_mpi.hpp node-count allreduce
         transposed) plus device-side sort/multipoles, so only O(#cells)
-        histograms and O(tree) arrays ever reach the host."""
+        histograms and O(tree) arrays ever reach the host; ``keys_cache``
+        carries _configure's (keys, order) so keygen+argsort over N runs
+        once per reconfigure, not once per consumer."""
         s = self.state
         if self._mesh is not None:
             from sphexa_tpu.gravity.tree import linkage_from_leaves
             from sphexa_tpu.parallel.sizing import leaf_array_from_device_keys
             from sphexa_tpu.sfc.keys import compute_sfc_keys
 
-            keys_d = compute_sfc_keys(s.x, s.y, s.z, self.box,
-                                      curve=self.curve)
+            if keys_cache is not None:
+                keys_d, order = keys_cache
+            else:
+                keys_d = compute_sfc_keys(s.x, s.y, s.z, self.box,
+                                          curve=self.curve)
+                order = jnp.argsort(keys_d)
             leaf_tree = leaf_array_from_device_keys(
                 keys_d, bucket_size=self.grav_bucket
             )
             gtree, meta = linkage_from_leaves(leaf_tree, curve=self.curve)
-            order = jnp.argsort(keys_d)
             skeys = keys_d[order]
             xs, ys, zs, ms = s.x[order], s.y[order], s.z[order], s.m[order]
         else:
@@ -462,20 +488,19 @@ class Simulation:
             gtree, meta = build_gravity_tree(
                 keys[order], bucket_size=self.grav_bucket, curve=self.curve
             )
+        # scale-dependent solver shape (target_block / hierarchical
+        # bitmask compaction at >= 500k, gravity_tuning) — bench.py uses
+        # the same helper so the benchmarked config IS this one
+        from sphexa_tpu.gravity.traversal import gravity_tuning
+
         gcfg = estimate_gravity_caps(
             xs, ys, zs, ms, skeys, self.box, gtree, meta,
             GravityConfig(theta=self.theta, bucket_size=self.grav_bucket,
                           G=self.const.g,
-                          # coarser classification blocks amortize the
-                          # dense blocks x nodes MAC sweep at large N
-                          # (measured 1.86x at 1M Plummer: tb=256 975 ms
-                          # vs tb=64 1810 ms, scripts/bench_gravity_scale
-                          # .py); small runs keep the tighter near field
-                          target_block=256 if self.state.n >= 500_000
-                          else 64,
-                          blocks_per_chunk=8 if self.state.n >= 500_000
-                          else 32,
-                          use_pallas=self._cfg.backend == "pallas"),
+                          m2p_cap_margin=self.m2p_cap_margin,
+                          **gravity_tuning(
+                              self.state.n,
+                              self._cfg.backend == "pallas")),
             margin=margin,
             # sharded solves classify against the per-shard essential
             # node set (LET analog) instead of the full replicated tree

@@ -158,6 +158,115 @@ class TestTreeVsDirect:
         assert np.isclose(float(egrav), -ms[0] * ms[1] / 10.0, rtol=1e-4)
 
 
+@pytest.fixture(scope="module")
+def bitmask_system():
+    """One shared 4000-particle Plummer system + sized caps + the dense
+    sort-path reference solve (the class below only asserts against it,
+    so build it once)."""
+    import dataclasses
+
+    x, y, z, m, h, keys, box = _sorted_system(4000)
+    cfg = GravityConfig(theta=0.5, bucket_size=64)
+    tree, meta = build_gravity_tree(np.asarray(keys), cfg.bucket_size)
+    cfg = estimate_gravity_caps(x, y, z, m, keys, box, tree, meta, cfg)
+    args = (x, y, z, m, h, keys, box, tree, meta)
+    return dataclasses, args, cfg, meta
+
+
+class TestBitmaskCompaction:
+    """Hierarchical bitmask-rank compaction (compaction="bitmask",
+    gravity/pallas_compact.py) vs the dense 3-class sort: the ISSUE-1
+    acceptance pin is EXACT equivalence — same accepted M2P/P2P sets in
+    the same slots, same first-accepted-ancestor classes — so the
+    accelerations must match BITWISE, not within a tolerance."""
+
+    def test_dense_bitmask_matches_sort_exactly(self, bitmask_system):
+        dc, args, cfg, meta = bitmask_system
+        out_s = compute_gravity(*args, cfg)
+        out_b = compute_gravity(
+            *args, dc.replace(cfg, compaction="bitmask")
+        )
+        for name, a, b in zip(("ax", "ay", "az", "egrav"),
+                              out_s[:4], out_b[:4]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=name
+            )
+        for k in ("m2p_max", "p2p_max", "leaf_occ"):
+            assert int(out_s[4][k]) == int(out_b[4][k]), k
+        assert int(out_b[4]["compact_width"]) == meta.num_nodes
+
+    def test_hierarchical_bitmask_matches_dense_sort_exactly(
+            self, bitmask_system):
+        """Two-level superblock pre-pass + kernel compaction vs the
+        dense sweep: identical lists (the super candidate cut is
+        ancestor-closed and super-accept implies block-accept)."""
+        dc, args, cfg, meta = bitmask_system
+        out_s = compute_gravity(*args, cfg)
+        cfg_h = dc.replace(cfg, compaction="bitmask", super_factor=8,
+                           super_cap=meta.num_nodes)
+        out_h = compute_gravity(*args, cfg_h)
+        for name, a, b in zip(("ax", "ay", "az", "egrav"),
+                              out_s[:4], out_h[:4]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=name
+            )
+        d = out_h[4]
+        assert int(d["m2p_max"]) == int(out_s[4]["m2p_max"])
+        assert int(d["p2p_max"]) == int(out_s[4]["p2p_max"])
+        # the pre-pass candidate cut is live and cap-guarded
+        assert 0 < int(d["c_max"]) <= meta.num_nodes
+        assert int(d["compact_width"]) == min(cfg_h.super_cap,
+                                              meta.num_nodes)
+
+    def test_cap_overflow_diagnostic_fires_not_silent(self, bitmask_system):
+        """Deliberately undersized caps: both compactions must truncate
+        to the SAME prefix (no silent divergence) and the m2p/p2p
+        high-water diagnostics must exceed the caps so the Simulation
+        driver regrows instead of silently dropping nodes."""
+        dc, args, cfg, _meta = bitmask_system
+        small = dc.replace(cfg, m2p_cap=32, p2p_cap=8)
+        out_s = compute_gravity(*args, small)
+        out_b = compute_gravity(
+            *args, dc.replace(small, compaction="bitmask")
+        )
+        assert int(out_b[4]["m2p_max"]) > small.m2p_cap
+        assert int(out_b[4]["p2p_max"]) > small.p2p_cap
+        assert int(out_b[4]["m2p_max"]) == int(out_s[4]["m2p_max"])
+        assert int(out_b[4]["p2p_max"]) == int(out_s[4]["p2p_max"])
+        for name, a, b in zip(("ax", "ay", "az"), out_s[:3], out_b[:3]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=name
+            )
+        # the driver-side guard sees these as an overflow and re-sizes
+        from sphexa_tpu.simulation import Simulation
+
+        diag = {k: np.asarray(v) for k, v in out_b[4].items()}
+        fake = type("S", (), {"gravity_on": True})()
+        fake._cfg = type("C", (), {"gravity": small})()
+        assert Simulation._gravity_overflowed(fake, diag)
+
+    def test_far_replica_root_accept_bitmask(self, bitmask_system):
+        """A far replica shift makes the ROOT pass the MAC; the
+        parent-geometry anc re-evaluation must not let the root count as
+        its own accepted ancestor (root's parent is itself)."""
+        import jax.numpy as jnp
+
+        dc, args, cfg, meta = bitmask_system
+        shift = jnp.asarray([50.0, 0.0, 0.0])
+        kw = dict(shift=shift, allow_self=jnp.asarray(True))
+        out_s = compute_gravity(*args, cfg, **kw)
+        cfg_h = dc.replace(cfg, compaction="bitmask", super_factor=8,
+                           super_cap=meta.num_nodes)
+        out_b = compute_gravity(*args, cfg_h, **kw)
+        assert float(out_s[3]) != 0.0
+        for name, a, b in zip(("ax", "ay", "az", "egrav"),
+                              out_s[:4], out_b[:4]):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=name
+            )
+        assert int(out_b[4]["m2p_max"]) == int(out_s[4]["m2p_max"]) >= 1
+
+
 @pytest.mark.slow
 def test_hierarchical_mac_matches_dense():
     """The two-level superblock classification must reproduce the dense

@@ -174,6 +174,37 @@ def test_ve_pipeline_matches_xla_interpret(case, av_clean):
     assert float(me1[4]) == pytest.approx(float(me0[4]), rel=1e-4)
 
 
+def test_gravity_compact_kernel_interpret():
+    """Bitmask+popcount-rank compaction kernel (gravity/pallas_compact.py)
+    vs a numpy reference: candidate-order lists, true (unclipped) counts,
+    cap truncation, 128-lane staging wrap, and tail padding — the
+    interpret-mode smoke that rides the tier-1 CPU gate."""
+    from sphexa_tpu.gravity import pallas_compact as pc
+
+    rng = np.random.default_rng(7)
+    # (B, C, cap0, cap1): non-multiple-of-128 caps/widths exercise the
+    # pad/trim paths; cap < count exercises truncation + the unclipped
+    # count contract; C < 128 exercises the single-chunk tail
+    for B, C, cap0, cap1 in ((4, 1000, 192, 64), (1, 90, 8, 8),
+                             (3, 513, 256, 48)):
+        cls = rng.integers(0, 3, size=(B, C))
+        vals = rng.integers(0, 1 << 20, size=(B, C))
+        packed = jnp.asarray((cls << pc.IDX_BITS) | vals, jnp.int32)
+        l0, n0, l1, n1 = pc.compact_class_lists(
+            packed, cap0, cap1, interpret=True
+        )
+        for b in range(B):
+            for lst, cnt, cap, k in ((l0, n0, cap0, 0), (l1, n1, cap1, 1)):
+                exp = vals[b][cls[b] == k]
+                assert int(cnt[b]) == len(exp)
+                kept = min(len(exp), cap)
+                np.testing.assert_array_equal(
+                    np.asarray(lst[b][:kept]), exp[:kept]
+                )
+                # slots beyond the count stay zeroed (masked by callers)
+                assert np.all(np.asarray(lst[b][kept:]) == 0)
+
+
 def test_gravity_p2p_pallas_matches_xla_interpret():
     """Streamed near-field P2P (gravity/traversal._pallas_p2p) vs the XLA
     gather formulation, both through compute_gravity."""

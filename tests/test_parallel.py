@@ -482,6 +482,52 @@ class TestShardedGravityFastPath:
             float(out_diag["egrav"]), float(ref_diag["egrav"]), rtol=1e-4
         )
 
+    def test_sharded_gravity_let_bitmask_matches_single(self):
+        """ISSUE-1 sharded coverage: the let_cap path feeding the
+        hierarchical bitmask-rank compaction (superblock pre-pass
+        classifying against the slab essential list, per-block lists
+        from gravity/pallas_compact.py) must match the single-device
+        dense-sort solve within the same MAC-marginal tolerance as the
+        sort-based sharded paths."""
+        import dataclasses as dc
+
+        import numpy as np
+
+        from sphexa_tpu.init import init_evrard
+        from sphexa_tpu.propagator import step_hydro_ve
+        from sphexa_tpu.simulation import Simulation
+
+        state, box, const = init_evrard(16)
+        n8 = (state.n // 8) * 8
+        state = jax.tree.map(
+            lambda a: a[:n8] if getattr(a, "ndim", 0) == 1 else a, state
+        )
+        sim = Simulation(state, box, const, prop="ve", block=512,
+                         backend="pallas")
+        ref_state, _, ref_diag = sim._launch()[:3]
+
+        num_nodes = sim._cfg.grav_meta.num_nodes
+        cfg_bm = dc.replace(
+            sim._cfg,
+            gravity=dc.replace(sim._cfg.gravity, let_cap=num_nodes,
+                               compaction="bitmask", super_factor=2,
+                               super_cap=num_nodes),
+        )
+        mesh = make_mesh(8)
+        sstate = shard_state(state, mesh)
+        step = make_sharded_step(mesh, cfg_bm, step_fn=step_hydro_ve)
+        out_state, _, out_diag = step(sstate, box, sim._gtree)
+        assert 0 < int(out_diag["let_max"]) <= num_nodes
+        assert 0 < int(out_diag["c_max"]) <= num_nodes
+        assert int(out_diag["compact_width"]) == num_nodes
+        np.testing.assert_allclose(
+            np.asarray(out_state.vx), np.asarray(ref_state.vx),
+            rtol=1e-2, atol=5e-4,
+        )
+        np.testing.assert_allclose(
+            float(out_diag["egrav"]), float(ref_diag["egrav"]), rtol=1e-4
+        )
+
 
 @pytest.mark.slow
 class TestShardedEwaldSpherical:
@@ -495,7 +541,6 @@ class TestShardedEwaldSpherical:
         import dataclasses as dc
         import functools
 
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from sphexa_tpu.gravity.ewald import compute_gravity_ewald
@@ -503,6 +548,7 @@ class TestShardedEwaldSpherical:
             compute_gravity,
             compute_multipoles_sharded,
         )
+        from sphexa_tpu.propagator import shard_map  # version-compat shim
 
         mesh = make_mesh(8)
         Pn = 8
@@ -528,10 +574,11 @@ class TestShardedEwaldSpherical:
             return gx, gy, gz, egrav, diag
 
         diag_keys = (
-            ["m2p_max", "p2p_max", "leaf_occ", "c_max", "let_max"]
+            ["m2p_max", "p2p_max", "leaf_occ", "c_max", "let_max",
+             "compact_width"]
             if ecfg is not None
             else ["m2p_max", "p2p_max", "leaf_occ", "c_max", "let_max",
-                  "mac_work_ratio"]
+                  "compact_width", "mac_work_ratio"]
         )
         Pp, Pr = P("p"), P()
         fn = shard_map(
