@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Repo gate: jaxlint + tier-1 tests — what CI (and a pre-push hook) runs.
+#
+#   scripts/check.sh            # lint + fast tier
+#   scripts/check.sh --lint-only
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== jaxlint (sphexa_tpu/, baseline: jaxlint_baseline.json) =="
+python -m sphexa_tpu.devtools.lint sphexa_tpu \
+    --baseline jaxlint_baseline.json
+lint_rc=$?
+if [ $lint_rc -ne 0 ]; then
+    echo "jaxlint failed (rc=$lint_rc); fix the findings or add an inline"
+    echo "'# jaxlint: disable=JXLxxx -- reason' (docs/STATIC_ANALYSIS.md)."
+    exit $lint_rc
+fi
+
+if [ "${1:-}" = "--lint-only" ]; then
+    exit 0
+fi
+
+echo "== tier-1 tests (fast tier, CPU) =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider

@@ -1,0 +1,2 @@
+"""Developer tooling that ships inside the package so CI and tests can
+import it without a separate install (jaxlint lives here)."""

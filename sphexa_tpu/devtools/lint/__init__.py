@@ -1,0 +1,35 @@
+"""jaxlint: in-repo AST static analysis for jit/tracer/dtype/Pallas hygiene.
+
+The bug classes the rules target are ones this codebase has actually hit
+(see docs/STATIC_ANALYSIS.md for the catalog and the war stories):
+
+- JXL001  module-level ``jnp``/``jax.numpy`` array construction
+          (import-time device placement / tracer leak)
+- JXL002  host sync inside jit-reachable code
+- JXL003  dtype-policy bypass in state-constructing modules
+- JXL004  Pallas BlockSpec tile shapes off the (8, 128) grid
+- JXL005  jit/shard_map static-argument hazards
+
+Usage::
+
+    python -m sphexa_tpu.devtools.lint sphexa_tpu
+    sphexa-lint sphexa_tpu --format json
+
+Suppress a single finding with an inline comment carrying a reason::
+
+    x = host_only_thing()  # jaxlint: disable=JXL002 -- driver-loop sync
+
+The analyzer is pure stdlib (``ast`` + ``tokenize``): it never imports the
+code it scans, so it is safe to run on modules whose import would grab a
+device.
+"""
+
+from sphexa_tpu.devtools.lint.core import (  # noqa: F401
+    Analyzer,
+    Finding,
+    ModuleInfo,
+    Rule,
+    all_rules,
+    lint_paths,
+)
+from sphexa_tpu.devtools.lint.baseline import Baseline  # noqa: F401

@@ -1,0 +1,7 @@
+"""``python -m sphexa_tpu.devtools.lint`` entry point."""
+
+import sys
+
+from sphexa_tpu.devtools.lint.cli import main
+
+sys.exit(main())

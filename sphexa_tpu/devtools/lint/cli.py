@@ -1,0 +1,97 @@
+"""jaxlint CLI.
+
+    python -m sphexa_tpu.devtools.lint sphexa_tpu
+    sphexa-lint sphexa_tpu --format json
+    sphexa-lint sphexa_tpu --baseline jaxlint_baseline.json --update-baseline
+
+Exit status: 0 = clean (no non-baselined findings), 1 = findings or
+parse errors, 2 = usage error. Pure stdlib + ast: does not import jax or
+any scanned module, so it is safe in pre-device-setup contexts (CI
+images without an accelerator, pre-commit hooks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from sphexa_tpu.devtools.lint.baseline import Baseline
+from sphexa_tpu.devtools.lint.core import Analyzer, all_rules
+from sphexa_tpu.devtools.lint.reporter import render_json, render_text
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="sphexa-lint",
+        description="jaxlint: AST static analysis for jit/tracer/dtype/"
+                    "Pallas hygiene (rules JXL001-JXL005).",
+    )
+    ap.add_argument("paths", nargs="*", default=["sphexa_tpu"],
+                    help="files or directories to scan "
+                         "(default: sphexa_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", metavar="IDS",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline with the current findings "
+                         "and exit 0")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list inline-suppressed and baselined "
+                         "findings (text format)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules().values():
+            print(f"{rule.id}  {rule.name}: {rule.description}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+    try:
+        analyzer = Analyzer(select=select)
+    except ValueError as e:
+        print(f"sphexa-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline and not args.baseline:
+        print("sphexa-lint: --update-baseline requires --baseline",
+              file=sys.stderr)
+        return 2
+
+    active, suppressed, errors = analyzer.run_paths(args.paths)
+
+    if args.update_baseline:
+        Baseline.from_findings(active).save(args.baseline)
+        print(f"sphexa-lint: wrote {len(active)} entr"
+              f"{'y' if len(active) == 1 else 'ies'} to {args.baseline}")
+        return 0
+
+    try:
+        baseline = Baseline.load(args.baseline) if args.baseline \
+            else Baseline.empty()
+    except (ValueError, OSError) as e:
+        print(f"sphexa-lint: cannot read baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+    new, grandfathered = baseline.filter_new(active)
+
+    if args.format == "json":
+        print(render_json(new, grandfathered, suppressed, errors))
+    else:
+        print(render_text(new, grandfathered, suppressed, errors,
+                          show_suppressed=args.show_suppressed))
+    return 1 if (new or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

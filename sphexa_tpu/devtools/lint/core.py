@@ -1,0 +1,301 @@
+"""Analyzer scaffolding: parsed-module model, rule registry, suppressions.
+
+Design notes
+------------
+- One ``ModuleInfo`` per file: source, AST, import-alias map, and the
+  suppression table parsed from comments. Rules are stateless visitors
+  that take a ``ModuleInfo`` and return ``Finding``s; the analyzer owns
+  filtering (suppressions, rule selection, baseline happens in the CLI).
+- Alias resolution is syntactic: ``import jax.numpy as jnp`` makes the
+  name ``jnp`` resolve to ``jax.numpy``, so rules match on canonical
+  dotted paths (``jax.numpy.zeros``) and survive local import styles
+  (``from jax.experimental import pallas as pl``). No code is imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "ModuleInfo",
+    "Rule",
+    "register",
+    "all_rules",
+    "Analyzer",
+    "lint_paths",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str          # "JXL001"
+    path: str          # posix path as given to the analyzer
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    snippet: str = ""  # stripped source line, for reports and baseline keys
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+_DISABLE_RE = re.compile(
+    r"#\s*jaxlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<codes>[A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+    r"(?:\s*--\s*(?P<reason>.*))?"
+)
+
+
+@dataclasses.dataclass
+class SuppressionTable:
+    """Per-line and file-wide ``# jaxlint: disable=`` directives.
+
+    A finding at line L is suppressed when its rule code appears in a
+    directive on line L itself, in a stand-alone comment in the run of
+    comment-only lines directly above L (plain explanatory comments in
+    the run don't break it), or in a ``disable-file=`` directive
+    anywhere in the file.
+    """
+
+    by_line: Dict[int, set]          # line -> {codes} (directive ON that line)
+    comment_only: Dict[int, set]     # comment-only DIRECTIVE lines
+    comment_lines: set               # ALL comment-only lines (any content)
+    file_wide: set
+
+    def is_suppressed(self, code: str, line: int) -> bool:
+        if code in self.file_wide:
+            return True
+        if code in self.by_line.get(line, ()):
+            return True
+        # run of comment-only lines directly above the finding
+        lookup = line - 1
+        while lookup in self.comment_lines:
+            if code in self.comment_only.get(lookup, ()):
+                return True
+            lookup -= 1
+        return False
+
+
+def parse_suppressions(source: str) -> SuppressionTable:
+    by_line: Dict[int, set] = {}
+    comment_only: Dict[int, set] = {}
+    comment_lines: set = set()
+    file_wide: set = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        line = tok.start[0]
+        standalone = tok.line[: tok.start[1]].strip() == ""
+        if standalone:
+            comment_lines.add(line)
+        m = _DISABLE_RE.search(tok.string)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group("codes").split(",")}
+        if m.group("file"):
+            file_wide |= codes
+            continue
+        by_line.setdefault(line, set()).update(codes)
+        if standalone:
+            comment_only.setdefault(line, set()).update(codes)
+    return SuppressionTable(by_line, comment_only, comment_lines, file_wide)
+
+
+# ---------------------------------------------------------------------------
+# parsed module + alias resolution
+# ---------------------------------------------------------------------------
+
+
+class ModuleInfo:
+    """A parsed source file plus the lookups every rule needs."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.suppressions = parse_suppressions(source)
+        self.aliases = self._collect_aliases(tree)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ModuleInfo":
+        source = Path(path).read_text()
+        tree = ast.parse(source, filename=path)
+        return cls(Path(path).as_posix(), source, tree)
+
+    @staticmethod
+    def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+        """Map local names to canonical dotted module/attribute paths,
+        from every import statement in the file (any nesting level —
+        this repo imports jnp inside functions routinely)."""
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:          # relative import: keep it unresolved
+                    continue
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, resolving the
+        root through the import-alias map; None for non-name expressions
+        (calls, subscripts) anywhere in the chain."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=self.line_at(getattr(node, "lineno", 1)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    description: str
+    check: Callable[[ModuleInfo], List[Finding]]
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(id: str, name: str, description: str):
+    """Decorator: register ``check(module) -> [Finding]`` under a rule id."""
+
+    def deco(fn: Callable[[ModuleInfo], List[Finding]]):
+        if id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {id}")
+        _REGISTRY[id] = Rule(id=id, name=name, description=description,
+                             check=fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    # importing the rules package populates the registry
+    import sphexa_tpu.devtools.lint.rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# analyzer
+# ---------------------------------------------------------------------------
+
+
+class Analyzer:
+    def __init__(self, select: Optional[Sequence[str]] = None):
+        rules = all_rules()
+        if select:
+            unknown = set(select) - set(rules)
+            if unknown:
+                raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+            rules = {k: v for k, v in rules.items() if k in select}
+        self.rules = rules
+
+    def run_module(self, module: ModuleInfo) -> Tuple[List[Finding],
+                                                      List[Finding]]:
+        """(active, suppressed) findings for one parsed module."""
+        active: List[Finding] = []
+        suppressed: List[Finding] = []
+        for rule in self.rules.values():
+            for f in rule.check(module):
+                if module.suppressions.is_suppressed(f.rule, f.line):
+                    suppressed.append(f)
+                else:
+                    active.append(f)
+        key = lambda f: (f.path, f.line, f.col, f.rule)
+        return sorted(active, key=key), sorted(suppressed, key=key)
+
+    def run_paths(self, paths: Iterable[str]) -> Tuple[List[Finding],
+                                                       List[Finding],
+                                                       List[Finding]]:
+        """(active, suppressed, errors) over files and directory trees.
+
+        Unparseable files become pseudo-findings with rule ``JXL000`` so a
+        syntax error can't silently shrink coverage.
+        """
+        active: List[Finding] = []
+        suppressed: List[Finding] = []
+        errors: List[Finding] = []
+        for path in sorted(self._expand(paths)):
+            try:
+                module = ModuleInfo.from_file(path)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                errors.append(Finding(
+                    rule="JXL000", path=Path(path).as_posix(),
+                    line=getattr(e, "lineno", None) or 1, col=0,
+                    message=f"could not parse: {e.__class__.__name__}: {e}",
+                ))
+                continue
+            a, s = self.run_module(module)
+            active += a
+            suppressed += s
+        return active, suppressed, errors
+
+    @staticmethod
+    def _expand(paths: Iterable[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            pp = Path(p)
+            if pp.is_dir():
+                out += [str(f) for f in pp.rglob("*.py")
+                        if "__pycache__" not in f.parts]
+            else:
+                out.append(str(pp))
+        return out
+
+
+def lint_paths(paths: Iterable[str], select: Optional[Sequence[str]] = None):
+    """One-call convenience: (active, suppressed, errors)."""
+    return Analyzer(select=select).run_paths(paths)

@@ -1,0 +1,9 @@
+"""Rule modules register themselves on import (core.register)."""
+
+from sphexa_tpu.devtools.lint.rules import (  # noqa: F401
+    jxl001_import_arrays,
+    jxl002_host_sync,
+    jxl003_dtype_policy,
+    jxl004_pallas_tiles,
+    jxl005_static_args,
+)

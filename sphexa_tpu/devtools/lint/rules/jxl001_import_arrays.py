@@ -1,0 +1,96 @@
+"""JXL001: module-level ``jnp``/``jax.numpy`` array construction.
+
+A jnp call at import time places a buffer on the default device before
+the application configures platforms/meshes, and — the bug PR 1 fixed by
+hand in parallel/exchange.py — a module first imported while a trace is
+live builds a TRACER, not an array, which then leaks into every later
+trace that touches the constant. Dtype ALIASES (``KEY_DTYPE =
+jnp.uint32``) are fine: only calls are flagged.
+
+Import-time scope = module body + class bodies + default-argument
+expressions of module/class-level defs. Code inside function bodies or
+lambdas only runs when called and is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from sphexa_tpu.devtools.lint.core import Finding, ModuleInfo, register
+
+# attribute-style jnp calls are matched by the jax.numpy. prefix below;
+# this covers array-building jax.* entry points outside that namespace
+_EXTRA_CONSTRUCTORS = {
+    "jax.device_put",
+}
+
+
+def _is_jnp_call(mod: ModuleInfo, call: ast.Call) -> bool:
+    q = mod.qualname(call.func)
+    if q is None:
+        return False
+    return q.startswith("jax.numpy.") or q in _EXTRA_CONSTRUCTORS
+
+
+def _scan_expr(mod: ModuleInfo, expr: ast.AST, out: List[Finding]):
+    """Flag jnp calls in an import-time-evaluated expression, without
+    descending into lambda bodies (deferred execution)."""
+    if isinstance(expr, ast.Lambda):
+        return
+    if isinstance(expr, ast.Call) and _is_jnp_call(mod, expr):
+        q = mod.qualname(expr.func)
+        out.append(mod.finding(
+            "JXL001", expr,
+            f"`{q}(...)` runs at import time: builds a device buffer "
+            f"before platform setup and leaks a tracer if the first "
+            f"import happens under a trace. Use a Python/numpy constant "
+            f"or construct lazily inside the function.",
+        ))
+    for child in ast.iter_child_nodes(expr):
+        _scan_expr(mod, child, out)
+
+
+def _scan_children(mod: ModuleInfo, node: ast.AST, out: List[Finding]):
+    """Recurse through control-flow scaffolding (withitem, excepthandler)
+    routing stmts back to _scan_body and exprs to _scan_expr."""
+    for sub in ast.iter_child_nodes(node):
+        if isinstance(sub, ast.stmt):
+            _scan_body(mod, [sub], out)
+        elif isinstance(sub, ast.expr):
+            _scan_expr(mod, sub, out)
+        else:
+            _scan_children(mod, sub, out)
+
+
+def _scan_body(mod: ModuleInfo, body: List[ast.stmt], out: List[Finding]):
+    for st in body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # decorators and default-arg expressions evaluate at def time
+            for dec in st.decorator_list:
+                _scan_expr(mod, dec, out)
+            for d in st.args.defaults + [d for d in st.args.kw_defaults if d]:
+                _scan_expr(mod, d, out)
+            continue
+        if isinstance(st, ast.ClassDef):
+            for dec in st.decorator_list:
+                _scan_expr(mod, dec, out)
+            _scan_body(mod, st.body, out)
+            continue
+        if isinstance(st, (ast.If, ast.Try, ast.With, ast.For, ast.While)):
+            # module-level control flow still executes at import
+            _scan_children(mod, st, out)
+            continue
+        _scan_expr(mod, st, out)
+
+
+@register(
+    "JXL001",
+    "module-level-jnp",
+    "jnp/jax.numpy array construction at import time (device placement "
+    "before setup; tracer leak if first-imported under a trace)",
+)
+def check(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    _scan_body(mod, mod.tree.body, out)
+    return out

@@ -1,0 +1,111 @@
+"""JXL002: host synchronization inside jit-reachable code.
+
+``.item()`` / ``float()`` / ``int()`` / ``bool()`` / ``np.asarray`` on a
+traced value either raises a ConcretizationTypeError at trace time or —
+worse, when the value happens to be concrete on the first call — silently
+re-triggers compilation and stalls the device pipeline on every step.
+The fixed-shape Cornerstone/Bonsai-style kernels this repo is built on
+only stay fast if nothing syncs the host mid-step.
+
+Scope comes from ``trace_scope.TraceScopes`` (jit decorators, functions
+passed to jax transforms / lax control flow / pallas_call, intra-module
+call-graph propagation). Conversions are only flagged when their
+argument derives from a NON-static parameter of the enclosing traced
+function — ``float(const.K)`` under ``static_argnames=("const",)`` and
+``int(x.shape[0])`` are static and stay legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from sphexa_tpu.devtools.lint.core import Finding, ModuleInfo, register
+from sphexa_tpu.devtools.lint.trace_scope import (
+    TraceScopes,
+    build_parent_map,
+    touches_dynamic,
+)
+
+_CONVERTERS = {"float", "int", "bool", "complex"}
+_NP_MATERIALIZERS = {
+    "numpy.asarray", "numpy.array", "numpy.asanyarray", "numpy.ascontiguousarray",
+}
+_ALWAYS_BAD_CALLS = {"jax.device_get"}
+_ALWAYS_BAD_METHODS = {"item", "block_until_ready", "tolist", "__array__"}
+
+
+@register(
+    "JXL002",
+    "host-sync-in-jit",
+    "host synchronization (.item(), float()/int()/bool() on traced values,"
+    " np.asarray on device arrays, device_get) inside jit-reachable code",
+)
+def check(mod: ModuleInfo) -> List[Finding]:
+    scopes = TraceScopes(mod)
+    if not scopes.traced:
+        return []
+    parents = build_parent_map(mod.tree)
+    out: List[Finding] = []
+
+    def dynamic_params_of(node: ast.AST) -> Set[str]:
+        """Union of dynamic params over the chain of enclosing traced
+        functions (closures over an outer traced arg still trace)."""
+        dyn: Set[str] = set()
+        cur = parents.get(node)
+        while cur is not None:
+            tf = scopes.traced.get(cur)
+            if tf is not None:
+                dyn |= tf.dynamic_params()
+            cur = parents.get(cur)
+        return dyn
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        owner = scopes.traced_owner(node, parents)
+        if owner is None:
+            continue
+
+        # .item() / .block_until_ready() / .tolist(): always a sync
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ALWAYS_BAD_METHODS
+                and not node.args):
+            via = owner.name or "<lambda>"
+            out.append(mod.finding(
+                "JXL002",
+                node,
+                f"`.{node.func.attr}()` inside jit-reachable "
+                f"`{via}` ({owner.via}) forces a device->host sync or "
+                f"fails on a tracer; hoist it out of the traced region.",
+            ))
+            continue
+
+        q = mod.qualname(node.func)
+        if q in _ALWAYS_BAD_CALLS:
+            via = owner.name or "<lambda>"
+            out.append(mod.finding(
+                "JXL002",
+                node,
+                f"`{q}(...)` inside jit-reachable `{via}` ({owner.via}) "
+                f"is a host transfer; return the value instead and fetch "
+                f"it outside the jit boundary.",
+            ))
+            continue
+
+        # conversions: only when fed (a derivative of) a traced parameter
+        if q in _CONVERTERS or q in _NP_MATERIALIZERS:
+            if not node.args:
+                continue
+            dyn = dynamic_params_of(node)
+            if dyn and touches_dynamic(mod, node.args[0], dyn):
+                via = owner.name or "<lambda>"
+                out.append(mod.finding(
+                    "JXL002",
+                    node,
+                    f"`{q}(...)` on a value derived from traced argument(s)"
+                    f" of `{via}` ({owner.via}): concretizes a tracer "
+                    f"(ConcretizationTypeError) or re-compiles per value. "
+                    f"Keep it as a jnp op, or mark the argument static.",
+                ))
+    return out

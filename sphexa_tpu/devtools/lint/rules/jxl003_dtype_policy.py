@@ -1,0 +1,77 @@
+"""JXL003: dtype-policy bypass in state-constructing modules.
+
+``sphexa_tpu/dtypes.py`` is the single switch for the framework's
+precision policy (f32 TPU-native today; a future mixed-precision PR
+flips it in ONE place). That only works if the modules that build
+particle state, SFC keys and snapshots spell dtypes through the policy
+names — a literal ``jnp.float32`` there silently pins the old policy.
+
+Scoped to the modules where state is born (init/, sfc/, io/,
+sph/particles.py): numerics kernels legitimately use explicit working
+precisions (e.g. a deliberate f32 accumulator inside a Pallas kernel)
+and are not policed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from sphexa_tpu.devtools.lint.core import Finding, ModuleInfo, register
+
+# path fragments that opt a module INTO the policy check
+POLICY_PATHS = (
+    "sphexa_tpu/init/",
+    "sphexa_tpu/sfc/",
+    "sphexa_tpu/io/",
+    "sphexa_tpu/sph/particles.py",
+    "lint_fixtures/numerics",   # fixture hook for tests/test_lint.py
+)
+
+# the policy module itself defines the aliases and is exempt
+EXEMPT_PATHS = ("sphexa_tpu/dtypes.py",)
+
+_SUGGESTION = {
+    "float32": "COORD_DTYPE/HYDRO_DTYPE",
+    "int32": "INDEX_DTYPE",
+    "uint32": "KEY_DTYPE",
+    "float64": "a policy dtype (f64 is not TPU-native)",
+    "int64": "INDEX_DTYPE (i64 is not TPU-native)",
+    "uint64": "KEY_DTYPE (u64 is not TPU-native)",
+    "float16": "HYDRO_DTYPE",
+    "bfloat16": "HYDRO_DTYPE",
+}
+
+
+def applies_to(path: str) -> bool:
+    if any(path.endswith(e) for e in EXEMPT_PATHS):
+        return False
+    return any(frag in path for frag in POLICY_PATHS)
+
+
+@register(
+    "JXL003",
+    "dtype-policy-bypass",
+    "literal jnp dtype (jnp.float32/int32/uint32/...) in a "
+    "state-constructing module instead of the dtypes.py policy names",
+)
+def check(mod: ModuleInfo) -> List[Finding]:
+    if not applies_to(mod.path):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        if node.attr not in _SUGGESTION:
+            continue
+        q = mod.qualname(node)
+        if q != f"jax.numpy.{node.attr}":
+            continue
+        out.append(mod.finding(
+            "JXL003",
+            node,
+            f"literal `jnp.{node.attr}` in a state-constructing module "
+            f"bypasses the dtype policy; use {_SUGGESTION[node.attr]} "
+            f"from sphexa_tpu.dtypes.",
+        ))
+    return out

@@ -1,0 +1,101 @@
+"""JXL004: Pallas VMEM tile shapes off the (8, 128) register grid.
+
+Mosaic lays VMEM out in (8, 128) f32 tiles (sublane x lane; see the
+Pallas TPU docs). A BlockSpec whose trailing dimension is not a multiple
+of 128, or whose second-to-last literal dimension is neither 1 nor a
+multiple of 8, either fails to lower or lowers with silent padding that
+wastes VMEM and vector issue slots — the exact overhead the fixed-shape
+kernel design exists to avoid.
+
+Only LITERAL dims are judged (symbolic sizes like ``(1, 1, G)`` are the
+caller's contract), and only for tiled memory spaces: ``memory_space=``
+SMEM/ANY/HOST specs are scalar/untiled and exempt. ``pltpu.VMEM`` scratch
+shapes are held to the same grid.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from sphexa_tpu.devtools.lint.core import Finding, ModuleInfo, register
+
+_BLOCKSPEC = "jax.experimental.pallas.BlockSpec"
+_VMEM_SCRATCH = (
+    "jax.experimental.pallas.tpu.VMEM",
+    "jax.experimental.pallas.mosaic.VMEM",
+)
+_UNTILED_SPACES = ("SMEM", "ANY", "HOST")
+
+
+def _literal_dims(node: ast.AST) -> Optional[List[Optional[int]]]:
+    """Tuple/List literal -> [int or None per dim]; None if not a
+    sequence literal at all."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    dims: List[Optional[int]] = []
+    for el in node.elts:
+        if isinstance(el, ast.Constant) and isinstance(el.value, int):
+            dims.append(el.value)
+        else:
+            dims.append(None)
+    return dims
+
+
+def _check_dims(mod: ModuleInfo, node: ast.AST, dims: List[Optional[int]],
+                what: str, out: List[Finding]):
+    if not dims:
+        return
+    last = dims[-1]
+    if last is not None and last % 128 != 0:
+        out.append(mod.finding(
+            "JXL004",
+            node,
+            f"{what} trailing dim {last} is not a multiple of 128 "
+            f"(Mosaic lane width); the block is padded to "
+            f"{-(-last // 128) * 128} lanes on chip.",
+        ))
+    if len(dims) >= 2:
+        second = dims[-2]
+        if second is not None and second != 1 and second % 8 != 0:
+            out.append(mod.finding(
+                "JXL004",
+                node,
+                f"{what} sublane dim {second} is neither 1 nor a multiple "
+                f"of 8 (f32 sublane count); pad the block to "
+                f"{-(-second // 8) * 8} rows or fold it into the grid.",
+            ))
+
+
+@register(
+    "JXL004",
+    "pallas-tile-shape",
+    "Pallas BlockSpec / VMEM scratch literal tile shape not aligned to "
+    "the (8, 128) Mosaic register grid",
+)
+def check(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        q = mod.qualname(node.func)
+        if q == _BLOCKSPEC:
+            space = next((kw.value for kw in node.keywords
+                          if kw.arg == "memory_space"), None)
+            if space is not None:
+                sq = mod.qualname(space) or ""
+                if sq.rsplit(".", 1)[-1] in _UNTILED_SPACES:
+                    continue
+            shape = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "block_shape"), None)
+            if shape is None:
+                continue
+            dims = _literal_dims(shape)
+            if dims is not None:
+                _check_dims(mod, node, dims, "BlockSpec", out)
+        elif q in _VMEM_SCRATCH and node.args:
+            dims = _literal_dims(node.args[0])
+            if dims is not None:
+                _check_dims(mod, node, dims, "VMEM scratch", out)
+    return out

@@ -1,0 +1,116 @@
+"""JXL005: jax.jit / shard_map static-argument hazards.
+
+Three concrete failure modes, all of which bite at call time (or worse,
+per-call) rather than at definition time:
+
+- ``static_argnames`` naming a parameter that does not exist (typo):
+  jax raises only when the name would matter, so the typo can sit dark
+  until a call-site change.
+- an unhashable (list/dict/set) default on a static parameter: the
+  first defaulted call dies with ``TypeError: unhashable type``; a
+  mutable default on a TRACED parameter instead bakes one abstract
+  value per identity and is a retrace hazard.
+- a config-like parameter (``cfg`` / ``*_cfg`` / ``config``) that is
+  NOT static: frozen config dataclasses flow through this codebase as
+  compile-time constants (every propagator entry point does
+  ``static_argnames=("cfg",)``); passing one positionally as a traced
+  arg either fails flatten-time or retraces on every new instance.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from sphexa_tpu.devtools.lint.core import Finding, ModuleInfo, register
+from sphexa_tpu.devtools.lint.trace_scope import (
+    _jit_call_of_decorator,
+    declared_statics,
+)
+
+_CONFIG_NAME = re.compile(r"(^|_)(cfg|config)$")
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+# decorators whose static_argnames/nums semantics we validate
+_JIT_LIKE = {"jax.jit", "jax.pmap", "shard_map",
+             "jax.experimental.shard_map.shard_map", "jax.shard_map",
+             "sphexa_tpu.propagator.shard_map"}
+
+
+@register(
+    "JXL005",
+    "jit-static-args",
+    "jax.jit/shard_map static-argument hazards: unknown static_argnames, "
+    "unhashable/mutable defaults, config dataclasses passed as traced args",
+)
+def check(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            hit = _jit_call_of_decorator(dec, mod)
+            if hit is None or hit[0] not in _JIT_LIKE:
+                continue
+            transform, call = hit
+            a = node.args
+            positional = [p.arg for p in a.posonlyargs + a.args]
+            all_params = set(positional) | {p.arg for p in a.kwonlyargs}
+            names, nums = declared_statics(call)
+
+            for name in sorted(names - all_params):
+                out.append(mod.finding(
+                    "JXL005", dec,
+                    f"static_argnames entry '{name}' does not match any "
+                    f"parameter of `{node.name}` "
+                    f"({', '.join(sorted(all_params)) or 'no params'}): "
+                    f"dead typo, the intended argument is traced.",
+                ))
+            # negative indices resolve from the end, as jax does
+            for num in nums:
+                if not (-len(positional) <= num < len(positional)):
+                    out.append(mod.finding(
+                        "JXL005", dec,
+                        f"static_argnums entry {num} is out of range for "
+                        f"`{node.name}` ({len(positional)} positional "
+                        f"parameters).",
+                    ))
+            static = names | {positional[i] for i in nums
+                              if -len(positional) <= i < len(positional)}
+
+            # defaults: align right-to-left with positional params
+            defaults = list(zip(positional[::-1], a.defaults[::-1]))
+            defaults += [(p.arg, d) for p, d in zip(a.kwonlyargs,
+                                                    a.kw_defaults) if d]
+            for pname, dflt in defaults:
+                if isinstance(dflt, _MUTABLE_LITERALS):
+                    if pname in static:
+                        out.append(mod.finding(
+                            "JXL005", dflt,
+                            f"unhashable default for static arg '{pname}' "
+                            f"of `{node.name}`: the first defaulted call "
+                            f"raises TypeError (static args are cache "
+                            f"keys). Use a tuple/frozen value.",
+                        ))
+                    else:
+                        out.append(mod.finding(
+                            "JXL005", dflt,
+                            f"mutable default for traced arg '{pname}' of "
+                            f"jitted `{node.name}`: one shared instance "
+                            f"across calls is a retrace/aliasing hazard. "
+                            f"Use None + in-body construction.",
+                        ))
+
+            # config-like params must be static (the repo-wide idiom)
+            for pname in positional + [p.arg for p in a.kwonlyargs]:
+                if _CONFIG_NAME.search(pname) and pname not in static:
+                    out.append(mod.finding(
+                        "JXL005", dec,
+                        f"config-like parameter '{pname}' of `{node.name}` "
+                        f"is traced under {transform}: frozen config "
+                        f"dataclasses are compile-time constants here — "
+                        f"add it to static_argnames (or rename if it "
+                        f"really is a traced pytree).",
+                    ))
+    return out

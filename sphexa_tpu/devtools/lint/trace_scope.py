@@ -1,0 +1,373 @@
+"""Which functions in a module run under a JAX trace, and which of their
+parameters carry tracers — the shared scope model behind JXL002/JXL005.
+
+"Jit-reachable" is computed per module, syntactically:
+
+1. roots: functions decorated with a tracing transform (``jax.jit``,
+   ``functools.partial(jax.jit, ...)``, ``jax.vmap`` ...) or passed by
+   name/lambda to a trace-inducing callable (``jax.jit(f)``,
+   ``jax.lax.fori_loop(0, n, body, x)``, ``pl.pallas_call(kernel, ...)``,
+   ``shard_map(f, ...)``). ``jax.lax`` control flow and ``pallas_call``
+   ALWAYS trace their function arguments, even when called from host
+   code, so they root reachability unconditionally.
+2. propagation: a plain-name call inside a traced function marks the
+   same-module function of that name traced too, and maps the call's
+   arguments onto the callee's parameters: a parameter is DYNAMIC
+   (tracer-carrying) only if some traced call site feeds it an
+   expression derived from a dynamic value. Arguments built from
+   ``static_argnames`` parameters, closure variables, or constants are
+   concrete at trace time, so ``float(cfg.x)`` in a helper stays legal
+   when every caller passes a static config. The transfer function is
+   monotone (dynamic sets only grow), so the worklist converges.
+
+Cross-module reachability is out of scope — each module is analyzed
+against its own roots. The model errs toward under-reporting rather
+than flooding host-side planner code with false positives; the fixture
+tests pin the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+from sphexa_tpu.devtools.lint.core import ModuleInfo
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+# transforms whose FIRST function argument is traced when called
+TRACING_CALLABLES = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.map",
+    "jax.experimental.pallas.pallas_call",
+    "jax.experimental.shard_map.shard_map",
+    "jax.shard_map",
+    # repo-local version shim around shard_map (propagator.shard_map)
+    "shard_map",
+    "sphexa_tpu.propagator.shard_map",
+}
+
+# jax.lax control flow: (canonical name, indices of traced function args)
+LAX_FN_ARGS = {
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.scan": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": None,   # every arg after the index may be a branch
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.custom_root": (0, 1, 2),
+}
+
+# decorators that make the decorated function a trace root
+TRACING_DECORATORS = TRACING_CALLABLES | {
+    "jax.custom_jvp",
+    "jax.custom_vjp",
+}
+
+# attribute reads that are static under tracing even on traced arrays
+STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "weak_type", "sharding"}
+
+
+def touches_dynamic(mod: ModuleInfo, expr: ast.AST, dyn: Set[str]) -> bool:
+    """Does ``expr`` (syntactically) derive from a name in ``dyn``?
+    Accesses routed through static attributes (``x.shape``) and ``len()``
+    don't count — those are concrete under tracing."""
+    if isinstance(expr, ast.Name):
+        return expr.id in dyn
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in STATIC_ATTRS:
+            return False
+        return touches_dynamic(mod, expr.value, dyn)
+    if isinstance(expr, ast.Call):
+        q = mod.qualname(expr.func)
+        if q == "len":
+            return False
+        args = list(expr.args) + [kw.value for kw in expr.keywords]
+        # a method call on a traced value is itself traced
+        if isinstance(expr.func, ast.Attribute):
+            args.append(expr.func.value)
+        return any(touches_dynamic(mod, a, dyn) for a in args)
+    return any(touches_dynamic(mod, c, dyn)
+               for c in ast.iter_child_nodes(expr))
+
+
+@dataclasses.dataclass
+class TracedFunction:
+    node: FunctionNode
+    name: Optional[str]            # None for lambdas
+    dynamic: Set[str]              # params that carry tracers
+    via: str                       # how it became traced (for messages)
+
+    def dynamic_params(self) -> Set[str]:
+        return set(self.dynamic)
+
+
+def _literal_ints(node: ast.AST) -> List[int]:
+    """Int literals in a (possibly nested) expression, honoring a unary
+    minus — ``ast.walk`` alone would strip the sign off ``-1``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if (isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, int)):
+        return [-node.operand.value]
+    out: List[int] = []
+    for child in ast.iter_child_nodes(node):
+        out += _literal_ints(child)
+    return out
+
+
+def declared_statics(call: Optional[ast.Call]) -> Tuple[Set[str], List[int]]:
+    """(static_argnames strings, static_argnums ints — sign preserved)
+    declared on a jit(...) / functools.partial(jax.jit, ...) call,
+    unvalidated."""
+    names: Set[str] = set()
+    nums: List[int] = []
+    if call is None:
+        return names, nums
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+        elif kw.arg == "static_argnums":
+            nums += _literal_ints(kw.value)
+    return names, nums
+
+
+def _static_names_from_call(call: ast.Call, mod: ModuleInfo,
+                            fn: Optional[FunctionNode]) -> Set[str]:
+    """Param names made static by a jit(...) call's static_argnames /
+    static_argnums (negative nums resolve from the end, as jax does)."""
+    positional: List[str] = []
+    if fn is not None:
+        a = fn.args
+        positional = [p.arg for p in a.posonlyargs + a.args]
+    names, nums = declared_statics(call)
+    out = set(names)
+    for i in nums:
+        if -len(positional) <= i < len(positional):
+            out.add(positional[i])
+    return out
+
+
+def _jit_call_of_decorator(dec: ast.expr, mod: ModuleInfo
+                           ) -> Optional[Tuple[str, Optional[ast.Call]]]:
+    """(transform qualname, call-with-kwargs or None) when ``dec`` is a
+    tracing decorator: bare ``@jax.jit``, ``@jax.jit(...)`` (jit as a
+    decorator factory), or ``@functools.partial(jax.jit, ...)``."""
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        q = mod.qualname(dec)
+        if q in TRACING_DECORATORS:
+            return q, None
+        return None
+    if not isinstance(dec, ast.Call):
+        return None
+    q = mod.qualname(dec.func)
+    if q in TRACING_DECORATORS:
+        return q, dec
+    if q == "functools.partial" and dec.args:
+        inner = mod.qualname(dec.args[0])
+        if inner in TRACING_DECORATORS:
+            return inner, dec
+    return None
+
+
+class TraceScopes:
+    """Traced-function table for one module. Query with ``traced_owner``."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.traced: Dict[FunctionNode, TracedFunction] = {}
+        self._all_functions: Dict[str, List[FunctionNode]] = {}
+        self._fn_parents: Dict[FunctionNode, Optional[FunctionNode]] = {}
+        self._collect_functions(mod.tree, None)
+        self._seed_roots()
+        self._propagate()
+
+    # -- construction -----------------------------------------------------
+
+    def _collect_functions(self, node: ast.AST,
+                           parent: Optional[FunctionNode]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                self._fn_parents[child] = parent
+                name = getattr(child, "name", None)
+                if name:
+                    self._all_functions.setdefault(name, []).append(child)
+                self._collect_functions(child, child)
+            else:
+                self._collect_functions(child, parent)
+
+    def _mark(self, fn: FunctionNode, via: str, dynamic: Set[str]) -> bool:
+        """Record fn as traced / widen its dynamic set. True if changed."""
+        tf = self.traced.get(fn)
+        if tf is None:
+            self.traced[fn] = TracedFunction(
+                node=fn, name=getattr(fn, "name", None),
+                dynamic=set(dynamic), via=via,
+            )
+            return True
+        if not dynamic <= tf.dynamic:
+            tf.dynamic |= dynamic
+            return True
+        return False
+
+    @staticmethod
+    def _all_param_names(fn: FunctionNode) -> Set[str]:
+        a = fn.args
+        names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+        return names
+
+    def _seed_roots(self):
+        mod = self.mod
+        # decorated roots: every non-static param carries tracers
+        for fn in self._fn_parents:
+            for dec in getattr(fn, "decorator_list", []):
+                hit = _jit_call_of_decorator(dec, mod)
+                if hit:
+                    q, call = hit
+                    static = (_static_names_from_call(call, mod, fn)
+                              if call is not None else set())
+                    self._mark(fn, f"@{q}",
+                               self._all_param_names(fn) - static)
+        # functions/lambdas passed to tracing callables
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = mod.qualname(node.func)
+            if q in TRACING_CALLABLES:
+                if node.args:
+                    self._root_fn_arg(node.args[0], q, node)
+            elif q in LAX_FN_ARGS:
+                idxs = LAX_FN_ARGS[q]
+                if idxs is None:
+                    idxs = range(1, len(node.args))
+                for i in idxs:
+                    if i < len(node.args):
+                        self._root_fn_arg(node.args[i], q, None)
+
+    def _root_fn_arg(self, arg: ast.expr, via: str,
+                     jit_call: Optional[ast.Call]):
+        targets: List[FunctionNode] = []
+        if isinstance(arg, ast.Lambda):
+            targets = [arg]
+        elif isinstance(arg, ast.Name):
+            targets = self._all_functions.get(arg.id, [])
+        for fn in targets:
+            static: Set[str] = set()
+            if jit_call is not None:
+                static = _static_names_from_call(jit_call, self.mod, fn)
+            self._mark(fn, f"passed to {via}",
+                       self._all_param_names(fn) - static)
+
+    # -- dataflow ---------------------------------------------------------
+
+    def _dyn_env(self, fn: FunctionNode) -> Set[str]:
+        """Dynamic names visible in fn's body: its own dynamic params plus
+        those of enclosing traced functions (closures over tracers)."""
+        dyn: Set[str] = set()
+        cur: Optional[FunctionNode] = fn
+        while cur is not None:
+            tf = self.traced.get(cur)
+            if tf is not None:
+                dyn |= tf.dynamic
+            cur = self._fn_parents.get(cur)
+        return dyn
+
+    def _site_dynamic_params(self, call: ast.Call, callee: FunctionNode,
+                             dyn_env: Set[str]) -> Set[str]:
+        """Callee params that receive a dynamic-derived expression at this
+        call site. Starred/unmappable sites degrade to all params."""
+        a = callee.args
+        positional = [p.arg for p in a.posonlyargs + a.args]
+        if any(isinstance(x, ast.Starred) for x in call.args) or any(
+                kw.arg is None for kw in call.keywords):
+            if any(touches_dynamic(self.mod, x.value
+                                   if isinstance(x, ast.Starred) else x,
+                                   dyn_env)
+                   for x in list(call.args)
+                   + [kw.value for kw in call.keywords]):
+                return self._all_param_names(callee)
+            return set()
+        out: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            if touches_dynamic(self.mod, arg, dyn_env):
+                if i < len(positional):
+                    out.add(positional[i])
+                elif a.vararg:
+                    out.add(a.vararg.arg)
+        valid_kw = {p.arg for p in a.args + a.posonlyargs + a.kwonlyargs}
+        for kw in call.keywords:
+            if touches_dynamic(self.mod, kw.value, dyn_env):
+                out.add(kw.arg if kw.arg in valid_kw
+                        else (a.kwarg.arg if a.kwarg else kw.arg))
+        return out
+
+    def _propagate(self):
+        """Worklist over the intra-module call graph + lexical nesting,
+        mapping dynamic-ness of call arguments onto callee params."""
+        work = list(self.traced)
+        while work:
+            fn = work.pop()
+            tf = self.traced.get(fn)
+            if tf is None:
+                continue
+            via_name = tf.name or "<lambda>"
+            dyn_env = self._dyn_env(fn)
+            changed: Set[FunctionNode] = set()
+            for node in ast.walk(fn):
+                # nested defs/lambdas run under the same trace; their
+                # params' dynamic-ness comes from call sites / lax roots
+                if (node is not fn and node in self._fn_parents
+                        and self._fn_parents[node] is fn):
+                    if self._mark(node, f"nested in traced {via_name}",
+                                  set()):
+                        changed.add(node)
+                # plain-name calls reach same-module functions
+                if isinstance(node, ast.Call) and isinstance(node.func,
+                                                             ast.Name):
+                    for callee in self._all_functions.get(node.func.id, []):
+                        site_dyn = self._site_dynamic_params(
+                            node, callee, dyn_env)
+                        if self._mark(callee,
+                                      f"called from traced {via_name}",
+                                      site_dyn):
+                            changed.add(callee)
+            for c in changed:
+                work.append(c)
+                # widening a function's params re-dirties its transitive
+                # callees via the worklist when it is reprocessed
+
+    # -- queries ----------------------------------------------------------
+
+    def traced_owner(self, node: ast.AST,
+                     parents: Dict[ast.AST, ast.AST]
+                     ) -> Optional[TracedFunction]:
+        """Innermost traced function whose body contains ``node``."""
+        cur = parents.get(node)
+        while cur is not None:
+            if cur in self.traced:
+                return self.traced[cur]
+            cur = parents.get(cur)
+        return None
+
+
+def build_parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents

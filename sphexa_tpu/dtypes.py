@@ -16,7 +16,11 @@ import jax.numpy as jnp
 # Key type for space-filling-curve keys. 10 levels x 3 bits = 30 bits.
 KEY_DTYPE = jnp.uint32
 KEY_BITS = 10  # octree levels encodable in a key
-KEY_MAX = jnp.uint32((1 << (3 * KEY_BITS)))  # one past the largest key
+# One past the largest key. A Python int, NOT a jnp scalar: a module-level
+# jnp constant grabs a device at import time and, if the first import
+# happens under a live trace, is born a tracer and leaks into every later
+# trace that reads it (JXL001 — the parallel/exchange.py INF32 bug class).
+KEY_MAX = 1 << (3 * KEY_BITS)
 
 COORD_DTYPE = jnp.float32
 HYDRO_DTYPE = jnp.float32

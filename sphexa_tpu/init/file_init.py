@@ -78,7 +78,7 @@ def init_file_split(
     import numpy as np
     import jax.numpy as jnp
 
-    from sphexa_tpu.dtypes import KEY_BITS, KEY_MAX
+    from sphexa_tpu.dtypes import HYDRO_DTYPE, KEY_BITS, KEY_DTYPE
     from sphexa_tpu.sfc.hilbert import hilbert_decode
     from sphexa_tpu.sfc.keys import compute_sfc_keys
 
@@ -120,7 +120,7 @@ def init_file_split(
     max_coord = float(1 << KEY_BITS)
     for j in range(1, num_splits):
         kj = (keys.astype(np.int64) + j * delta).astype(np.uint64)
-        ix, iy, iz = hilbert_decode(jnp.asarray(kj, dtype=jnp.uint32))
+        ix, iy, iz = hilbert_decode(jnp.asarray(kj, dtype=KEY_DTYPE))
         xs[j::num_splits] = lo[0] + np.asarray(ix) * lengths[0] / max_coord
         ys[j::num_splits] = lo[1] + np.asarray(iy) * lengths[1] / max_coord
         zs[j::num_splits] = lo[2] + np.asarray(iz) * lengths[2] / max_coord
@@ -140,15 +140,15 @@ def init_file_split(
         m=jnp.asarray(replicate(state.m, 1.0 / num_splits)),
         h=jnp.asarray(replicate(state.h, inv_cbrt)),
         temp=jnp.asarray(replicate(state.temp)),
-        temp_lo=jnp.zeros(n1, jnp.float32),
+        temp_lo=jnp.zeros(n1, HYDRO_DTYPE),
         alpha=jnp.asarray(replicate(state.alpha)),
-        du=jnp.zeros(n1, jnp.float32),
-        du_m1=jnp.zeros(n1, jnp.float32),
+        du=jnp.zeros(n1, HYDRO_DTYPE),
+        du_m1=jnp.zeros(n1, HYDRO_DTYPE),
         x_m1=jnp.asarray(vx * min_dt),
         y_m1=jnp.asarray(vy * min_dt),
         z_m1=jnp.asarray(vz * min_dt),
-        ttot=jnp.float32(0.0),
-        min_dt=jnp.float32(min_dt),
-        min_dt_m1=jnp.float32(min_dt),
+        ttot=HYDRO_DTYPE(0.0),
+        min_dt=HYDRO_DTYPE(min_dt),
+        min_dt_m1=HYDRO_DTYPE(min_dt),
     )
     return new_state, box, const

@@ -12,6 +12,7 @@ from typing import Dict, Optional
 import numpy as np
 import jax.numpy as jnp
 
+from sphexa_tpu.dtypes import HYDRO_DTYPE
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
 
 
@@ -47,21 +48,21 @@ def build_state(
     """
     n = np.asarray(x).shape[0]
     f32 = lambda a: (
-        jnp.full(n, float(a), jnp.float32)
+        jnp.full(n, float(a), HYDRO_DTYPE)
         if np.ndim(a) == 0
-        else jnp.asarray(a, jnp.float32)
+        else jnp.asarray(a, HYDRO_DTYPE)
     )
     vx, vy, vz = f32(vx), f32(vy), f32(vz)
-    zeros = jnp.zeros(n, jnp.float32)
+    zeros = jnp.zeros(n, HYDRO_DTYPE)
     return ParticleState(
         x=f32(x), y=f32(y), z=f32(z),
         x_m1=vx * min_dt, y_m1=vy * min_dt, z_m1=vz * min_dt,
         vx=vx, vy=vy, vz=vz,
         h=f32(h), m=f32(m), temp=f32(temp), temp_lo=zeros,
         du=zeros, du_m1=zeros, alpha=f32(alpha),
-        ttot=jnp.float32(0.0),
-        min_dt=jnp.float32(min_dt),
-        min_dt_m1=jnp.float32(min_dt_m1 if min_dt_m1 is not None else min_dt),
+        ttot=HYDRO_DTYPE(0.0),
+        min_dt=HYDRO_DTYPE(min_dt),
+        min_dt_m1=HYDRO_DTYPE(min_dt_m1 if min_dt_m1 is not None else min_dt),
     )
 
 

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 import jax.numpy as jnp
 
+from sphexa_tpu.dtypes import COORD_DTYPE, HYDRO_DTYPE
 from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
 
@@ -393,20 +394,20 @@ def read_snapshot_full(
     const = SimConstants(**const_kw).normalized()
 
     box = Box(
-        lo=jnp.asarray(attrs["box_lo"], jnp.float32),
-        hi=jnp.asarray(attrs["box_hi"], jnp.float32),
+        lo=jnp.asarray(attrs["box_lo"], COORD_DTYPE),
+        hi=jnp.asarray(attrs["box_hi"], COORD_DTYPE),
         boundaries=tuple(BoundaryType(int(b)) for b in attrs["box_boundaries"]),
     )
 
-    f32 = lambda k: jnp.asarray(fields[k], jnp.float32)
+    f32 = lambda k: jnp.asarray(fields[k], HYDRO_DTYPE)
     state = ParticleState(
         **{f: f32(f) for f in CONSERVED_FIELDS},
         # the energy-update compensation carry is not serialized (it is
         # < 1 ulp of temp); restarting resets it
-        temp_lo=jnp.zeros_like(jnp.asarray(fields["temp"], jnp.float32)),
-        ttot=jnp.float32(attrs["time"]),
-        min_dt=jnp.float32(attrs["minDt"]),
-        min_dt_m1=jnp.float32(attrs["minDt_m1"]),
+        temp_lo=jnp.zeros_like(jnp.asarray(fields["temp"], HYDRO_DTYPE)),
+        ttot=HYDRO_DTYPE(attrs["time"]),
+        min_dt=HYDRO_DTYPE(attrs["minDt"]),
+        min_dt_m1=HYDRO_DTYPE(attrs["minDt_m1"]),
     )
     extra = {k: v for k, v in fields.items() if k not in CONSERVED_FIELDS}
     return state, box, const, extra, attrs

@@ -14,6 +14,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from sphexa_tpu.dtypes import COORD_DTYPE
+
 
 class BoundaryType(enum.IntEnum):
     """Per-dimension boundary behavior (cstone/sfc/box.hpp BoundaryType)."""
@@ -47,8 +49,8 @@ class Box:
             ymin, ymax, zmin, zmax = xmin, xmax, xmin, xmax
         if isinstance(boundary, BoundaryType):
             boundary = (boundary, boundary, boundary)
-        lo = jnp.array([xmin, ymin, zmin], dtype=jnp.float32)
-        hi = jnp.array([xmax, ymax, zmax], dtype=jnp.float32)
+        lo = jnp.array([xmin, ymin, zmin], dtype=COORD_DTYPE)
+        hi = jnp.array([xmax, ymax, zmax], dtype=COORD_DTYPE)
         return Box(lo=lo, hi=hi, boundaries=tuple(BoundaryType(b) for b in boundary))
 
     @property

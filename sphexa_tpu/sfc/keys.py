@@ -8,7 +8,7 @@ reference's ``SfcKind = HilbertKey`` default (sfc.hpp:53-55).
 
 import jax.numpy as jnp
 
-from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
+from sphexa_tpu.dtypes import INDEX_DTYPE, KEY_BITS, KEY_DTYPE
 from sphexa_tpu.sfc.box import Box
 from sphexa_tpu.sfc.hilbert import hilbert_encode
 from sphexa_tpu.sfc.morton import morton_encode
@@ -18,7 +18,7 @@ def coords_to_igrid(v, vmin, vmax, bits: int = KEY_BITS):
     """Map float coordinates in [vmin, vmax] to integers in [0, 2**bits)."""
     n = 1 << bits
     scaled = (v - vmin) / (vmax - vmin) * n
-    return jnp.clip(scaled.astype(jnp.int32), 0, n - 1).astype(KEY_DTYPE)
+    return jnp.clip(scaled.astype(INDEX_DTYPE), 0, n - 1).astype(KEY_DTYPE)
 
 
 def compute_sfc_keys(x, y, z, box: Box, bits: int = KEY_BITS, curve: str = "hilbert"):

@@ -19,6 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from sphexa_tpu.dtypes import HYDRO_DTYPE
 from sphexa_tpu.sph.kernels import kernel_norm_3d
 
 
@@ -68,7 +69,7 @@ class ParticleState:
         return self.x.shape[0]
 
     @staticmethod
-    def zeros(n: int, dtype=jnp.float32) -> "ParticleState":
+    def zeros(n: int, dtype=HYDRO_DTYPE) -> "ParticleState":
         f = lambda: jnp.zeros(n, dtype)
         s = lambda v: jnp.asarray(v, dtype)
         return ParticleState(

@@ -1,0 +1,45 @@
+"""JXL001 fixture: import-time jnp construction (never imported, only
+parsed — tests/test_lint.py matches findings against `# expect:` tags)."""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import numpy as jnumpy
+
+KEY_DTYPE = jnp.uint32                      # ok: alias, not a call
+KEY_BITS = 10                               # ok: python int
+BAD_SCALAR = jnp.uint32(1 << 30)            # expect: JXL001
+BAD_TABLE = jnp.zeros((8, 128))             # expect: JXL001
+BAD_VIA_FROM = jnumpy.arange(4)             # expect: JXL001
+BAD_DEVICE = jax.device_put(np.zeros(3))    # expect: JXL001
+OK_NUMPY = np.zeros(3)                      # ok: host constant
+OK_LAZY = lambda: jnp.zeros(3)              # ok: deferred
+
+
+if KEY_BITS > 5:
+    BAD_IN_IF = jnp.ones(2)                 # expect: JXL001
+
+try:
+    BAD_IN_TRY = jnp.full(3, 1.0)           # expect: JXL001
+except Exception:
+    pass
+
+
+class Config:
+    BAD_CLASS_ATTR = jnp.array([1.0])       # expect: JXL001
+    OK_ALIAS = jnp.float32                  # ok: alias
+
+
+def bad_default(x, scale=jnp.float32(2.0)):  # expect: JXL001
+    return x * scale
+
+
+def ok_inside():
+    return jnp.zeros(3)                     # ok: runs at call time
+
+
+@functools.partial(jax.jit, static_argnames=())
+def ok_decorated(x):                        # ok: jit at import is fine
+    return x + 1

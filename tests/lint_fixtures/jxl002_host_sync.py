@@ -1,0 +1,69 @@
+"""JXL002 fixture: host syncs in jit-reachable code vs. legal static uses."""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_item(x):
+    return x.item()                          # expect: JXL002
+
+
+@jax.jit
+def bad_conversions(x, n):
+    a = float(x)                             # expect: JXL002
+    b = int(n + 1)                           # expect: JXL002
+    c = bool(x > 0)                          # expect: JXL002
+    d = np.asarray(x)                        # expect: JXL002
+    return a + b + c + d
+
+
+@jax.jit
+def bad_device_get(x):
+    y = jax.device_get(x)                    # expect: JXL002
+    x.block_until_ready()                    # expect: JXL002
+    return y
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n"))
+def ok_static_args(x, cfg, n):
+    pad = int(n * cfg.margin)                # ok: both static
+    lo = float(cfg.floor)                    # ok: static config
+    return x[:pad] + lo
+
+
+@jax.jit
+def ok_shape_math(x):
+    rows = int(x.shape[0])                   # ok: shapes are static
+    total = float(np.prod(x.shape))          # ok
+    k = int(len(x) // 2)                     # ok: len is static
+    return x * rows * total + x[k]
+
+
+def _helper(v, cfg):
+    scale = float(cfg.scale)                 # ok: cfg static at call site
+    return float(v)                          # expect: JXL002
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def bad_through_helper(x, cfg):
+    return _helper(x, cfg)
+
+
+def _loop_body(i, carry):
+    return carry + int(i)                    # expect: JXL002
+
+
+def driver(x):
+    # lax control flow traces its body even from host code
+    total = jax.lax.fori_loop(0, 8, _loop_body, x)
+    return float(total)                      # ok: outside any trace
+
+
+@jax.jit
+def suppressed_sync(x):
+    # jaxlint: disable=JXL002 -- deliberate: fixture for suppression test
+    return x.item()

@@ -1,0 +1,22 @@
+"""JXL004 fixture: Pallas tile shapes off the (8, 128) Mosaic grid."""
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def specs(G, kernel):
+    bad_lane = pl.BlockSpec((1, 8, 100), lambda g: (g, 0, 0))      # expect: JXL004
+    bad_sublane = pl.BlockSpec((1, 5, 128), lambda g: (g, 0, 0))   # expect: JXL004
+    ok_tile = pl.BlockSpec((1, 8, 256), lambda g: (g, 0, 0))       # ok
+    ok_row = pl.BlockSpec((1, 1, 128), lambda g: (g, 0, 0))        # ok: sublane 1
+    ok_sym = pl.BlockSpec((1, 1, G), lambda g: (g, 0, 0))          # ok: symbolic
+    ok_any = pl.BlockSpec(memory_space=pl.ANY)                     # ok: untiled
+    ok_smem = pl.BlockSpec((1, 1, 3), lambda g: (0, 0, 0),
+                           memory_space=pltpu.SMEM)                # ok: scalar mem
+    bad_kw = pl.BlockSpec(block_shape=(16, 64),                    # expect: JXL004
+                          index_map=lambda g: (g, 0))
+    bad_scratch = pltpu.VMEM((2, 3, 128), jnp.float32)             # expect: JXL004
+    ok_scratch = pltpu.VMEM((2, 8, 128), jnp.float32)              # ok
+    return (bad_lane, bad_sublane, ok_tile, ok_row, ok_sym, ok_any,
+            ok_smem, bad_kw, bad_scratch, ok_scratch)

@@ -1,0 +1,47 @@
+"""JXL005 fixture: jit/shard_map static-argument hazards."""
+
+import functools
+
+import jax
+
+from sphexa_tpu.propagator import shard_map
+
+
+@functools.partial(jax.jit, static_argnames=("cgf",))   # expect: JXL005, JXL005
+def typo_static(x, cfg):
+    # the typo'd name is dead AND cfg is silently traced (two findings)
+    return x * cfg.scale
+
+
+@functools.partial(jax.jit, static_argnums=(3,))        # expect: JXL005
+def out_of_range(x, y):
+    return x + y
+
+
+@jax.jit
+def mutable_default(x, opts=[]):                        # expect: JXL005
+    return x if not opts else x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("table",))
+def unhashable_static(x, table={}):                     # expect: JXL005
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def ok_static_cfg(x, cfg):                              # ok: repo idiom
+    return x * cfg.scale
+
+
+@functools.partial(jax.jit, static_argnums=(-1,))
+def ok_negative_static(x, cfg):                         # ok: cfg static via -1
+    return x * float(cfg.scale)
+
+
+@functools.partial(shard_map, mesh=None, in_specs=(), out_specs=())  # expect: JXL005
+def sharded_cfg(x, halo_cfg):
+    return x + halo_cfg.width
+
+
+def plain_helper(x, cfg):                               # ok: not jitted
+    return x * cfg.scale

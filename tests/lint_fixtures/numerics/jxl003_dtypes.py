@@ -1,0 +1,19 @@
+"""JXL003 fixture: dtype-policy bypasses. Lives under a ``numerics``
+directory because the rule is path-scoped to state-constructing modules."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from sphexa_tpu.dtypes import COORD_DTYPE, HYDRO_DTYPE, INDEX_DTYPE
+
+
+def build(n):
+    x = jnp.zeros(n, jnp.float32)            # expect: JXL003
+    i = jnp.arange(n, dtype=jnp.int32)       # expect: JXL003
+    k = jnp.asarray(i, jnp.uint32)           # expect: JXL003
+    w = jnp.asarray(x, jnp.float64)          # expect: JXL003
+    ok_x = jnp.zeros(n, COORD_DTYPE)         # ok: policy name
+    ok_h = jnp.ones(n, HYDRO_DTYPE)          # ok
+    ok_i = jnp.arange(n, dtype=INDEX_DTYPE)  # ok
+    ok_np = np.zeros(n, np.float32)          # ok: host-side numpy
+    return x, i, k, w, ok_x, ok_h, ok_i, ok_np

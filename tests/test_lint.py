@@ -1,0 +1,229 @@
+"""jaxlint: rule fixtures, suppressions, baseline round-trip, CLI, and
+the tier-1 gate that keeps sphexa_tpu/ clean.
+
+Fixture contract: every file under tests/lint_fixtures/ carries
+``# expect: JXLxxx`` markers on the lines that must produce findings
+(repeat the code for multiple findings on one line); the test fails on
+both missed findings AND unexpected ones, so rule false positives break
+CI the same way false negatives do.
+"""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from sphexa_tpu.devtools.lint import Analyzer, Baseline, all_rules
+from sphexa_tpu.devtools.lint.cli import main as lint_main
+from sphexa_tpu.devtools.lint.core import _DISABLE_RE, ModuleInfo
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)")
+
+
+def expected_findings(path: Path):
+    """[(line, rule)] from # expect: markers."""
+    out = []
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        m = _EXPECT_RE.search(line)
+        if m:
+            for code in m.group(1).split(","):
+                out.append((i, code.strip()))
+    return sorted(out)
+
+
+def run_file(path: Path):
+    return Analyzer().run_module(ModuleInfo.from_file(str(path)))
+
+
+FIXTURE_FILES = sorted(
+    p.relative_to(FIXTURES).as_posix() for p in FIXTURES.rglob("*.py")
+)
+
+
+def test_rule_registry_complete():
+    rules = all_rules()
+    assert sorted(rules) == ["JXL001", "JXL002", "JXL003", "JXL004",
+                             "JXL005"]
+    for rule in rules.values():
+        assert rule.description
+
+
+@pytest.mark.parametrize("rel", FIXTURE_FILES)
+def test_fixture_findings_exact(rel):
+    """Each fixture's active findings == its # expect: markers, exactly."""
+    path = FIXTURES / rel
+    active, _suppressed = run_file(path)
+    actual = sorted((f.line, f.rule) for f in active)
+    expected = expected_findings(path)
+    assert actual == expected, (
+        f"{rel}: findings disagree with markers\n"
+        f"  unexpected: {sorted(set(actual) - set(expected))}\n"
+        f"  missed:     {sorted(set(expected) - set(actual))}\n"
+        + "\n".join(f.format() for f in active)
+    )
+
+
+def test_inline_suppression_swallows_finding():
+    active, suppressed = run_file(FIXTURES / "jxl002_host_sync.py")
+    sup_lines = [(f.rule, "item()" in f.snippet) for f in suppressed]
+    assert ("JXL002", True) in sup_lines, (
+        "the # jaxlint: disable=JXL002 item() sync should be suppressed, "
+        f"got suppressed={sup_lines}"
+    )
+    # and it must NOT be double-reported as active
+    assert all("suppressed_sync" not in f.snippet for f in active)
+
+
+def test_same_line_and_file_wide_suppression(tmp_path):
+    src = (
+        "import jax.numpy as jnp\n"
+        "A = jnp.zeros(3)  # jaxlint: disable=JXL001 -- test constant\n"
+        "B = jnp.ones(3)\n"
+    )
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    active, suppressed = run_file(p)
+    assert [f.line for f in active] == [3]
+    assert [f.line for f in suppressed] == [2]
+
+    p.write_text("# jaxlint: disable-file=JXL001 -- generated module\n"
+                 + src.replace("  # jaxlint: disable=JXL001 -- test constant",
+                               ""))
+    active, suppressed = run_file(p)
+    assert active == []
+    assert len(suppressed) == 2
+
+
+def test_suppression_survives_intervening_plain_comment(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text(
+        "import jax.numpy as jnp\n"
+        "# jaxlint: disable=JXL001 -- deliberate import-time table\n"
+        "# (precomputed here on purpose; see docs)\n"
+        "TABLE = jnp.zeros(3)\n"
+    )
+    active, suppressed = run_file(p)
+    assert active == [] and [f.line for f in suppressed] == [4]
+
+
+def test_unknown_rule_selection_rejected():
+    with pytest.raises(ValueError):
+        Analyzer(select=["JXL999"])
+
+
+def test_select_limits_rules():
+    active, _sup, _err = Analyzer(select=["JXL001"]).run_paths(
+        [str(FIXTURES / "jxl002_host_sync.py")]
+    )
+    # JXL001 alone finds nothing in the host-sync fixture
+    assert active == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    fixture = FIXTURES / "jxl001_module_level.py"
+    active, _, _ = Analyzer().run_paths([str(fixture)])
+    assert active, "fixture must produce findings for this test"
+
+    bl_path = tmp_path / "baseline.json"
+    Baseline.from_findings(active).save(str(bl_path))
+    loaded = Baseline.load(str(bl_path))
+    new, grandfathered = loaded.filter_new(active)
+    assert new == [] and len(grandfathered) == len(active)
+
+    # a brand-new finding is NOT absorbed by the baseline
+    extra = tmp_path / "extra.py"
+    extra.write_text("import jax.numpy as jnp\nC = jnp.zeros(4)\n")
+    active2, _, _ = Analyzer().run_paths([str(fixture), str(extra)])
+    new2, _ = loaded.filter_new(active2)
+    assert [f.path for f in new2] == [extra.as_posix()]
+
+    # consuming semantics: a DUPLICATE of a baselined line is new
+    dup = tmp_path / "dup.py"
+    line = "K = jnp.uint32(1 << 30)\n"
+    dup.write_text("import jax.numpy as jnp\n" + line + line)
+    active3, _, _ = Analyzer().run_paths([str(dup)])
+    assert len(active3) == 2
+    bl3 = Baseline.from_findings(active3[:1])  # grandfather ONE copy
+    new3, old3 = bl3.filter_new(active3)
+    assert len(new3) == 1 and len(old3) == 1
+
+
+def test_baseline_missing_file_is_empty(tmp_path):
+    bl = Baseline.load(str(tmp_path / "nope.json"))
+    assert bl.entries == {}
+
+
+def test_cli_text_json_and_exit_codes(tmp_path, capsys):
+    dirty = FIXTURES / "jxl001_module_level.py"
+    clean = tmp_path / "clean.py"
+    clean.write_text("import numpy as np\nA = np.zeros(3)\n")
+
+    assert lint_main([str(clean)]) == 0
+    assert lint_main([str(dirty)]) == 1
+    capsys.readouterr()
+
+    assert lint_main([str(dirty), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"] and payload["errors"] == []
+    assert {f["rule"] for f in payload["findings"]} == {"JXL001"}
+
+    # baseline workflow through the CLI: grandfather, then gate passes
+    bl = tmp_path / "bl.json"
+    assert lint_main([str(dirty), "--baseline", str(bl),
+                      "--update-baseline"]) == 0
+    capsys.readouterr()
+    assert lint_main([str(dirty), "--baseline", str(bl)]) == 0
+
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "JXL001" in out and "JXL005" in out
+
+
+def test_cli_reports_parse_errors(tmp_path, capsys):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert lint_main([str(broken)]) == 1
+    assert "JXL000" in capsys.readouterr().out
+
+
+def test_cli_usage_errors(tmp_path):
+    assert lint_main(["--select", "NOPE1", "x.py"]) == 2
+    assert lint_main(["--update-baseline", "x.py"]) == 2
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{not json")
+    assert lint_main([str(FIXTURES), "--baseline", str(corrupt)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+
+
+def test_package_is_lint_clean():
+    """sphexa_tpu/ must stay free of non-suppressed findings — the
+    acceptance gate. Fix the finding, or (for a deliberate pattern) add
+    `# jaxlint: disable=JXLxxx -- reason` on the line."""
+    active, _suppressed, errors = Analyzer().run_paths(
+        [str(REPO_ROOT / "sphexa_tpu")]
+    )
+    msgs = "\n".join(f.format() + ("\n    " + f.snippet if f.snippet else "")
+                     for f in errors + active)
+    assert not errors and not active, (
+        f"jaxlint found {len(active)} finding(s) / {len(errors)} parse "
+        f"error(s) in sphexa_tpu/:\n{msgs}"
+    )
+
+
+def test_suppressions_in_package_carry_reasons():
+    """Every inline disable in the package must say WHY (-- reason)."""
+    bad = []
+    for p in (REPO_ROOT / "sphexa_tpu").rglob("*.py"):
+        for i, line in enumerate(p.read_text().splitlines(), start=1):
+            m = _DISABLE_RE.search(line)
+            if m and not (m.group("reason") or "").strip():
+                bad.append(f"{p}:{i}: {line.strip()}")
+    assert not bad, "suppressions without a reason:\n" + "\n".join(bad)
