@@ -1,25 +1,52 @@
 #!/usr/bin/env bash
-# Repo gate: jaxlint + tier-1 tests — what CI (and a pre-push hook) runs.
+# Repo gate: jaxlint (AST) -> jaxaudit (trace) -> tier-1 tests — what CI
+# (and a pre-push hook) runs.
 #
-#   scripts/check.sh            # lint + fast tier
+#   scripts/check.sh              # lint + audit + fast tier
 #   scripts/check.sh --lint-only
+#   scripts/check.sh --audit-only
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "== jaxlint (sphexa_tpu/, baseline: jaxlint_baseline.json) =="
-python -m sphexa_tpu.devtools.lint sphexa_tpu \
-    --baseline jaxlint_baseline.json
-lint_rc=$?
-if [ $lint_rc -ne 0 ]; then
-    echo "jaxlint failed (rc=$lint_rc); fix the findings or add an inline"
-    echo "'# jaxlint: disable=JXLxxx -- reason' (docs/STATIC_ANALYSIS.md)."
-    exit $lint_rc
-fi
+run_lint() {
+    echo "== jaxlint (sphexa_tpu/, baseline: jaxlint_baseline.json) =="
+    python -m sphexa_tpu.devtools.lint sphexa_tpu \
+        --baseline jaxlint_baseline.json
+    local rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "jaxlint failed (rc=$rc); fix the findings or add an inline"
+        echo "'# jaxlint: disable=JXLxxx -- reason' (docs/STATIC_ANALYSIS.md)."
+        exit $rc
+    fi
+}
 
-if [ "${1:-}" = "--lint-only" ]; then
-    exit 0
-fi
+run_audit() {
+    echo "== jaxaudit (entry registry, baseline: jaxaudit_baseline.json) =="
+    python -m sphexa_tpu.devtools.audit sphexa_tpu \
+        --baseline jaxaudit_baseline.json
+    local rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "jaxaudit failed (rc=$rc); fix the findings or add an inline"
+        echo "'# jaxaudit: disable=JXAxxx -- reason' on the entry"
+        echo "registration (docs/STATIC_ANALYSIS.md)."
+        exit $rc
+    fi
+}
+
+case "${1:-}" in
+    --lint-only)
+        run_lint
+        exit 0
+        ;;
+    --audit-only)
+        run_audit
+        exit 0
+        ;;
+esac
+
+run_lint
+run_audit
 
 echo "== tier-1 tests (fast tier, CPU) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

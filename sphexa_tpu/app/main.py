@@ -86,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kernel", default=None,
                    help="SPH kernel family: sinc | sinc-n1-n2 | wendland-c6 "
                         "(sph_kernel_tables.hpp SphKernelType)")
+    p.add_argument("--debug-checks", action="store_true", dest="debug_checks",
+                   help="run the step under the checkify sanitizer "
+                        "(NaN/Inf + out-of-bounds-index checks); the "
+                        "first failed check per step is reported per "
+                        "iteration (slow; single-device)")
     p.add_argument("--sincIndex", type=float, default=None, dest="sinc_index",
                    help="sinc kernel exponent n (default: case setting)")
     return p
@@ -259,7 +264,8 @@ def main(argv=None) -> int:
                          chem=chem_restored, cooling_cfg=cooling_cfg,
                          keep_fields=observable.needs_fields, theta=args.theta,
                          m2p_cap_margin=args.m2p_cap_margin,
-                         num_devices=args.devices, halo_mode=args.halo_mode)
+                         num_devices=args.devices, halo_mode=args.halo_mode,
+                         debug_checks=args.debug_checks)
     except (NotImplementedError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2
@@ -445,6 +451,9 @@ def main(argv=None) -> int:
         d = sim.step()
         timer.step("step")
         it = sim.iteration
+        if args.debug_checks and d.get("check_error"):
+            print(f"# debug-checks it {it}: {d['check_error']}",
+                  file=sys.stderr)
         e = conserved_quantities(sim.state, const, egrav=d.get("egrav", 0.0))
         fields = {"rho": d["rho"], "c": d["c"]} if observable.needs_fields else None
         row = constants.write(it, sim.state, sim.box, e, fields)

@@ -1,0 +1,40 @@
+"""jaxaudit: trace-level semantic auditor for the package's hot jits.
+
+The AST layer (devtools/lint) enforces what SOURCE must look like; this
+layer enforces what the TRACER must produce. Each registered entry point
+(devtools/audit/registry.py) is traced/lowered on tiny synthetic args
+and checked against the invariants the ROADMAP's perf posture depends
+on:
+
+- JXA101  dtype promotion above the 32-bit dtypes.py policy
+- JXA102  recompile-signature drift (step-2 retrace, weak-type leaks)
+- JXA103  declared-donatable buffers not donated in the hot lowering
+- JXA104  callback/device_put host-boundary leaks in the traced body
+- JXA105  oversized constants baked into the jaxpr
+- JXA106  collectives over axes outside the declared mesh sharding
+
+Usage::
+
+    python -m sphexa_tpu.devtools.audit sphexa_tpu
+    sphexa-audit sphexa_tpu --format json
+    sphexa-audit --list-rules
+
+Suppress a finding with an inline comment (with a reason) on or directly
+above the entry's ``@entrypoint`` registration::
+
+    # jaxaudit: disable=JXA105 -- deliberate precomputed mode table
+
+``JXA000`` is reserved for entries whose build or trace fails — broken
+registry entries can never silently shrink coverage.
+"""
+
+from sphexa_tpu.devtools.audit.core import (  # noqa: F401
+    Auditor,
+    EntryCase,
+    EntryPoint,
+    EntrySkip,
+    all_rules,
+    entries_from_namespace,
+    entrypoint,
+)
+from sphexa_tpu.devtools.common import Baseline, Finding  # noqa: F401

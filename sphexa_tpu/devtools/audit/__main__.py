@@ -1,0 +1,7 @@
+"""``python -m sphexa_tpu.devtools.audit`` entry point."""
+
+import sys
+
+from sphexa_tpu.devtools.audit.cli import main
+
+sys.exit(main())

@@ -1,0 +1,161 @@
+"""jaxaudit CLI.
+
+    python -m sphexa_tpu.devtools.audit sphexa_tpu
+    sphexa-audit sphexa_tpu --format json
+    sphexa-audit sphexa_tpu --baseline jaxaudit_baseline.json
+    sphexa-audit tests/audit_fixtures/jxa105_const.py --select JXA105
+
+Exit status mirrors sphexa-lint: 0 = clean (no non-baselined findings),
+1 = findings or entry errors, 2 = usage error.
+
+Unlike the lint CLI this one IMPORTS and TRACES the code it audits, so
+it needs a jax backend. By default it bootstraps a small virtual CPU
+mesh (``--cpu-devices``, default 2) before jax initializes, so sharded
+registry entries are auditable from a plain shell; pass
+``--cpu-devices 0`` to audit on the ambient backend instead (e.g. to
+inspect real TPU lowerings).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from sphexa_tpu.devtools.common import finish_cli
+
+_DEFAULT_TARGET = "sphexa_tpu"
+_PACKAGE_REGISTRY = "sphexa_tpu.devtools.audit.registry"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="sphexa-audit",
+        description="jaxaudit: trace-level jaxpr/lowering auditor "
+                    "(rules JXA101-JXA106) over the registered hot "
+                    "entry points.",
+    )
+    ap.add_argument("targets", nargs="*", default=[_DEFAULT_TARGET],
+                    help="registry modules: 'sphexa_tpu' (the package "
+                         "registry), a dotted module name, or a .py file "
+                         "defining @entrypoint builders "
+                         "(default: sphexa_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", metavar="IDS",
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--entries", metavar="NAMES",
+                    help="comma-separated entry names to audit "
+                         "(default: all registered)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline with the current findings "
+                         "and exit 0")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list inline-suppressed and baselined "
+                         "findings (text format)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--list-entries", action="store_true",
+                    help="print the registered entry points and exit")
+    ap.add_argument("--cpu-devices", type=int, default=2, metavar="N",
+                    help="bootstrap an N-virtual-device CPU backend "
+                         "before jax initializes so sharded entries "
+                         "trace (default 2; 0 = use the ambient backend)")
+    return ap
+
+
+def _load_target(target: str):
+    """Import a registry target: the package alias, a module, or a file."""
+    if target == _DEFAULT_TARGET:
+        target = _PACKAGE_REGISTRY
+    p = Path(target)
+    if p.suffix == ".py" and p.exists():
+        spec = importlib.util.spec_from_file_location(p.stem, p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    return importlib.import_module(target)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # heavy imports AFTER argparse so --help stays instant
+    from sphexa_tpu.devtools.audit.core import (
+        Auditor,
+        all_rules,
+        entries_from_namespace,
+    )
+
+    if args.list_rules:
+        for rule in all_rules().values():
+            print(f"{rule.id}  {rule.name}: {rule.description}")
+        return 0
+
+    if args.cpu_devices and args.cpu_devices > 0:
+        from sphexa_tpu.util.cpu_mesh import force_cpu_mesh
+
+        try:
+            force_cpu_mesh(args.cpu_devices)
+        except RuntimeError as e:
+            # ambient backend already up (in-process use) — sharded
+            # entries skip themselves if it can't host their mesh
+            print(f"sphexa-audit: note: CPU-mesh bootstrap skipped ({e})",
+                  file=sys.stderr)
+
+    entries = []
+    for target in args.targets:
+        try:
+            mod = _load_target(target)
+        except (ImportError, OSError, SyntaxError) as e:
+            print(f"sphexa-audit: cannot load target {target!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        entries += entries_from_namespace(vars(mod))
+    if args.entries:
+        want = {s.strip() for s in args.entries.split(",") if s.strip()}
+        unknown = want - {e.name for e in entries}
+        if unknown:
+            print(f"sphexa-audit: unknown entry name(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        entries = [e for e in entries if e.name in want]
+
+    if args.list_entries:
+        for e in entries:
+            extras = []
+            if e.donate:
+                extras.append(f"donate={e.donate}")
+            if e.mesh_axes:
+                extras.append(f"mesh_axes={e.mesh_axes}")
+            print(f"{e.name}  ({e.path}:{e.line})"
+                  + (f"  [{', '.join(extras)}]" if extras else ""))
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+    try:
+        auditor = Auditor(select=select)
+    except ValueError as e:
+        print(f"sphexa-audit: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline and not args.baseline:
+        print("sphexa-audit: --update-baseline requires --baseline",
+              file=sys.stderr)
+        return 2
+
+    active, suppressed, errors, skipped = auditor.run_entries(entries)
+    for note in skipped:
+        print(f"sphexa-audit: skipped {note}", file=sys.stderr)
+    return finish_cli("sphexa-audit", "jaxaudit", args, active, suppressed,
+                      errors)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

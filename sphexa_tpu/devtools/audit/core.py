@@ -1,0 +1,389 @@
+"""jaxaudit core: entry-point model, trace cache, rule registry, runner.
+
+Where jaxlint reads SOURCE (ast, never imports the code), jaxaudit reads
+what the TRACER produces: it imports the package, traces each registered
+entry point on small synthetic example args, and checks invariants on the
+resulting jaxpr / lowered module. The two layers are complementary — an
+AST pass structurally cannot see a silent f64 promotion inside a jitted
+step, a missed buffer donation, a constant baked into the jaxpr, or a
+step-2 retrace; the tracer sees exactly those.
+
+Model
+-----
+- An ``EntryPoint`` is a *declaration*: a name, audit metadata (declared
+  donation, declared mesh axes, const-size budget), and a lazy ``build``
+  callable returning an ``EntryCase`` with the traced function + example
+  args. Building is lazy so importing a registry module stays cheap and
+  device-free (the same hygiene jaxlint enforces on the package).
+- ``EntryTrace`` caches everything expensive per entry — the closed
+  jaxpr, the lowering, the executed output for the recompile carry — so
+  each rule pays only for what it reads and nothing is traced twice.
+- Rules are ``check(trace) -> [Finding]`` callables registered under JXA
+  ids, mirroring the lint rule registry. Findings anchor at the entry's
+  *registration site* (the decorated builder in the registry module), so
+  the shared inline-suppression grammar applies:
+  ``# jaxaudit: disable=JXA103 -- reason`` on or directly above the
+  ``@entrypoint`` line.
+
+``JXA000`` is reserved for entries whose build or trace raises — a broken
+registry entry can never silently shrink coverage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import traceback
+from pathlib import Path
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from sphexa_tpu.devtools.common import (
+    Finding,
+    SuppressionTable,
+    make_disable_re,
+    parse_suppressions,
+)
+
+__all__ = [
+    "EntryCase",
+    "EntryPoint",
+    "EntryTrace",
+    "EntrySkip",
+    "entrypoint",
+    "entries_from_namespace",
+    "Rule",
+    "register",
+    "all_rules",
+    "Auditor",
+    "subjaxprs",
+    "all_closed_jaxprs",
+]
+
+_DISABLE_RE = make_disable_re("jaxaudit")
+
+
+class EntrySkip(Exception):
+    """Raised by a builder when its environment prerequisites are absent
+    (e.g. a sharded entry on a single-device host). Skips are REPORTED,
+    not errors — but the tier-1 gate asserts none occur under the test
+    mesh, so coverage can't rot silently."""
+
+
+@dataclasses.dataclass
+class EntryCase:
+    """The concrete traced case an entry's builder produces.
+
+    ``fn`` takes ONLY traced arguments (close over static configs in the
+    builder) so ``jax.make_jaxpr(fn)(*args)`` works directly. ``lower``
+    is the AOT lowering thunk for the donation audit — for jitted
+    functions return ``jitted.lower(*full_args)`` of the variant the hot
+    path actually uses (the donated twin where one exists). ``carry``
+    rebuilds step-2 args from (step-1 args, step-1 outputs) for the
+    recompile audit; it must only REARRANGE pytree leaves.
+    """
+
+    fn: Callable
+    args: Tuple[Any, ...]
+    lower: Optional[Callable[[], Any]] = None
+    carry: Optional[Callable[[Tuple[Any, ...], Any], Tuple[Any, ...]]] = None
+    # optional weak-type probe: a variant of ``args`` with host-fed
+    # scalars (Python floats where the public API tolerates either);
+    # the traced OUTPUT signature must match the canonical one
+    perturb: Optional[Callable[[Tuple[Any, ...]], Tuple[Any, ...]]] = None
+
+
+@dataclasses.dataclass
+class EntryPoint:
+    """A registered auditable entry: declaration + lazy case builder."""
+
+    name: str
+    build: Callable[[], EntryCase]
+    # positions in the lowered ``args_info`` tuple whose WHOLE pytree
+    # must be donated (static args are elided from args_info; count only
+    # traced positionals)
+    donate: Tuple[int, ...] = ()
+    # collective axis names the entry's declared sharding provides;
+    # () = unsharded (any named-axis collective is then a finding)
+    mesh_axes: Tuple[str, ...] = ()
+    # jaxpr-constant size budget (bytes) for the const-bloat audit
+    const_bytes_limit: int = 1 << 20
+    # trace under jax.experimental.enable_x64 (fixture use: the f64
+    # rule can't fire with x64 off — jax silently demotes)
+    x64: bool = False
+    path: str = "?"
+    line: int = 0
+
+
+def _display_path(filename: str) -> str:
+    """cwd-relative posix path when possible: findings (and therefore the
+    committed baseline's (rule, path, hash) keys) must not embed the
+    machine-specific absolute checkout path, or a baseline written on one
+    machine never matches on another."""
+    p = Path(filename)
+    try:
+        return p.relative_to(Path.cwd()).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def entrypoint(name: str, *, donate: Tuple[int, ...] = (),
+               mesh_axes: Tuple[str, ...] = (),
+               const_bytes_limit: int = 1 << 20,
+               x64: bool = False) -> Callable:
+    """Decorator: declare a builder function as an audit entry point.
+
+    The decorated function runs lazily (per audit run) and returns an
+    ``EntryCase``. The binding in the module namespace becomes the
+    registry entry; findings anchor at the builder's definition line.
+    """
+
+    def deco(build: Callable[[], EntryCase]) -> EntryPoint:
+        code = getattr(build, "__code__", None)
+        return EntryPoint(
+            name=name, build=build, donate=tuple(donate),
+            mesh_axes=tuple(mesh_axes),
+            const_bytes_limit=const_bytes_limit, x64=x64,
+            path=_display_path(code.co_filename) if code else "?",
+            line=code.co_firstlineno if code else 0,
+        )
+
+    return deco
+
+
+def entries_from_namespace(ns: Dict[str, Any]) -> List[EntryPoint]:
+    """Collect EntryPoint bindings from a module namespace, in source
+    order (the module-level registry contract: decorate builders with
+    ``@entrypoint`` and this picks them up — no global mutable state)."""
+    entries = [v for v in ns.values() if isinstance(v, EntryPoint)]
+    names = [e.name for e in entries]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(f"duplicate audit entry name(s): {sorted(dupes)}")
+    return sorted(entries, key=lambda e: (e.path, e.line))
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking helpers
+# ---------------------------------------------------------------------------
+
+
+def subjaxprs(jaxpr) -> Iterable:
+    """Yield every eqn of ``jaxpr`` and of all nested sub-jaxprs (pjit
+    bodies, scan/while/cond branches, shard_map bodies, custom_* calls)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for w in (v if isinstance(v, (list, tuple)) else (v,)):
+                if hasattr(w, "eqns"):            # raw Jaxpr
+                    yield from subjaxprs(w)
+                elif hasattr(w, "jaxpr") and hasattr(
+                        getattr(w, "jaxpr"), "eqns"):  # ClosedJaxpr
+                    yield from subjaxprs(w.jaxpr)
+
+
+def all_closed_jaxprs(closed) -> Iterable:
+    """Yield ``closed`` and every nested ClosedJaxpr (their ``consts``
+    are where pjit-internal constants hide)."""
+    seen = set()
+
+    def walk(cj):
+        if id(cj) in seen:
+            return
+        seen.add(id(cj))
+        yield cj
+        for eqn in cj.jaxpr.eqns:
+            for v in eqn.params.values():
+                for w in (v if isinstance(v, (list, tuple)) else (v,)):
+                    if hasattr(w, "jaxpr") and hasattr(w, "consts"):
+                        yield from walk(w)
+                    elif hasattr(w, "eqns"):
+                        # raw Jaxpr: constvars but no const VALUES; the
+                        # values live on an enclosing ClosedJaxpr
+                        for eq2 in subjaxprs(w):
+                            for v2 in eq2.params.values():
+                                for w2 in (v2 if isinstance(v2, (list, tuple))
+                                           else (v2,)):
+                                    if hasattr(w2, "jaxpr") and hasattr(
+                                            w2, "consts"):
+                                        yield from walk(w2)
+
+    yield from walk(closed)
+
+
+# ---------------------------------------------------------------------------
+# per-entry trace cache
+# ---------------------------------------------------------------------------
+
+
+class EntryTrace:
+    """Lazily computed, cached trace artifacts for one entry.
+
+    Rules pull ``closed_jaxpr`` (tracing only — no compile), ``lowered``
+    (AOT lowering — no compile), or ``out`` (one real execution, only the
+    recompile rule needs it: weak_type does not survive into
+    ShapeDtypeStructs, so carried avals must come from concrete outputs).
+    """
+
+    def __init__(self, entry: EntryPoint, case: EntryCase):
+        self.entry = entry
+        self.case = case
+        self._closed = None
+        self._lowered = None
+        self._out = dataclasses.MISSING
+
+    def _x64_scope(self):
+        import contextlib
+
+        if not self.entry.x64:
+            return contextlib.nullcontext()
+        from jax.experimental import enable_x64
+
+        return enable_x64()
+
+    @property
+    def closed_jaxpr(self):
+        if self._closed is None:
+            import jax
+
+            with self._x64_scope():
+                self._closed = jax.make_jaxpr(self.case.fn)(*self.case.args)
+        return self._closed
+
+    @property
+    def lowered(self):
+        if self._lowered is None and self.case.lower is not None:
+            with self._x64_scope():
+                self._lowered = self.case.lower()
+        return self._lowered
+
+    @property
+    def out(self):
+        if self._out is dataclasses.MISSING:
+            with self._x64_scope():
+                self._out = self.case.fn(*self.case.args)
+        return self._out
+
+    def finding(self, rule: str, message: str) -> Finding:
+        e = self.entry
+        return Finding(rule=rule, path=e.path, line=e.line, col=0,
+                       message=f"[{e.name}] {message}",
+                       snippet=f"entry:{e.name}")
+
+
+# ---------------------------------------------------------------------------
+# rule registry (mirrors devtools/lint/core.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    description: str
+    check: Callable[[EntryTrace], List[Finding]]
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(id: str, name: str, description: str):
+    """Decorator: register ``check(trace) -> [Finding]`` under a rule id."""
+
+    def deco(fn: Callable[[EntryTrace], List[Finding]]):
+        if id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {id}")
+        _REGISTRY[id] = Rule(id=id, name=name, description=description,
+                             check=fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    # importing the rules package populates the registry
+    import sphexa_tpu.devtools.audit.rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+class Auditor:
+    def __init__(self, select: Optional[Sequence[str]] = None):
+        rules = all_rules()
+        if select:
+            unknown = set(select) - set(rules)
+            if unknown:
+                raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+            rules = {k: v for k, v in rules.items() if k in select}
+        self.rules = rules
+        self._suppressions: Dict[str, SuppressionTable] = {}
+
+    def _suppression_table(self, path: str) -> SuppressionTable:
+        if path not in self._suppressions:
+            try:
+                source = Path(path).read_text()
+            except OSError:
+                source = ""
+            self._suppressions[path] = parse_suppressions(source, _DISABLE_RE)
+        return self._suppressions[path]
+
+    def run_entries(self, entries: Sequence[EntryPoint]
+                    ) -> Tuple[List[Finding], List[Finding], List[Finding],
+                               List[str]]:
+        """(active, suppressed, errors, skipped_names) over the entries.
+
+        A builder/trace failure becomes a ``JXA000`` pseudo-finding (not
+        suppressible away by accident: it carries the exception). An
+        ``EntrySkip`` lands in ``skipped_names`` for the caller to gate.
+        """
+        active: List[Finding] = []
+        suppressed: List[Finding] = []
+        errors: List[Finding] = []
+        skipped: List[str] = []
+        for entry in entries:
+            try:
+                case = entry.build()
+            except EntrySkip as e:
+                skipped.append(f"{entry.name}: {e}")
+                continue
+            except Exception as e:  # noqa: BLE001 - reported as JXA000
+                errors.append(Finding(
+                    rule="JXA000", path=entry.path, line=entry.line, col=0,
+                    message=f"[{entry.name}] entry build failed: "
+                            f"{e.__class__.__name__}: {e}",
+                ))
+                continue
+            trace = EntryTrace(entry, case)
+            table = self._suppression_table(entry.path)
+            for rule in self.rules.values():
+                try:
+                    found = rule.check(trace)
+                except Exception as e:  # noqa: BLE001 - reported as JXA000
+                    tb = traceback.format_exc(limit=3)
+                    errors.append(Finding(
+                        rule="JXA000", path=entry.path, line=entry.line,
+                        col=0,
+                        message=f"[{entry.name}] {rule.id} crashed: "
+                                f"{e.__class__.__name__}: {e}\n{tb}",
+                    ))
+                    continue
+                for f in found:
+                    if table.is_suppressed(f.rule, f.line):
+                        suppressed.append(f)
+                    else:
+                        active.append(f)
+        key = lambda f: (f.path, f.line, f.rule, f.message)
+        return (sorted(active, key=key), sorted(suppressed, key=key),
+                sorted(errors, key=key), skipped)

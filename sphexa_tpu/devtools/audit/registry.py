@@ -1,0 +1,251 @@
+"""jaxaudit entry-point registry: the package's hot jitted functions.
+
+Each ``@entrypoint`` builder constructs a SMALL synthetic case (the
+``init/`` case builders at tiny N, the same ``Simulation`` configuration
+machinery production uses — so the audited config IS the shipped config)
+and returns the traced callable + example args. Builders run lazily per
+audit run and import jax-heavy modules inside the function body, so
+importing this module stays cheap and device-free.
+
+Conventions:
+
+- step entries audit the plain jit for tracing/execution and the
+  ``*_donated`` twin's lowering for the donation rule (``donate=(0,)`` =
+  the ParticleState pytree at lowered arg position 0; static args are
+  elided from ``args_info``).
+- ``carry`` maps (step-1 args, step-1 out) -> step-2 args, giving the
+  recompile rule real committed avals (weak types visible).
+- sharded entries declare ``mesh_axes`` and build their mesh from the
+  first devices of the process — on hosts with one device they raise
+  ``EntrySkip`` (the tier-1 gate runs under the 8-virtual-device CPU
+  mesh and asserts no skips; the CLI bootstraps a 2-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+from sphexa_tpu.devtools.audit.core import EntryCase, EntrySkip, entrypoint
+
+# tiny-but-nondegenerate case sizes: big enough for a real neighbor grid
+# and a multi-level gravity tree, small enough that a full step traces
+# and runs in ~seconds on a CPU host
+_SIDE = 6          # 216 particles (cube cases)
+_SIDE_GRAV = 6     # sphere cuts (evrard) keep ~half of side^3
+
+
+@functools.lru_cache(maxsize=None)
+def _sim(case: str, side: int, prop: str = "std"):
+    """Memoized Simulation construction: entries only READ the sim's
+    state/config products, so sharing one build between entries (e.g.
+    step_nbody + gravity_solve both want the configured evrard nbody
+    sim, gravity caps included) halves the audit's setup cost."""
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.simulation import Simulation
+
+    state, box, const = make_initializer(case)(side)
+    return Simulation(state, box, const, prop=prop)
+
+
+# ---------------------------------------------------------------------------
+# propagator step builders (the five production steps)
+# ---------------------------------------------------------------------------
+
+
+@entrypoint("step_std", donate=(0,))
+def step_std():
+    from sphexa_tpu import propagator as prop
+
+    sim = _sim("sedov", _SIDE, prop="std")
+    cfg, state, box = sim._cfg, sim.state, sim.box
+    return EntryCase(
+        fn=lambda s, b: prop.step_hydro_std(s, b, cfg, None),
+        args=(state, box),
+        lower=lambda: prop.step_hydro_std_donated.lower(state, box, cfg,
+                                                        None),
+        carry=lambda a, out: (out[0], out[1]),
+    )
+
+
+@entrypoint("step_ve", donate=(0,))
+def step_ve():
+    from sphexa_tpu import propagator as prop
+
+    sim = _sim("sedov", _SIDE, prop="ve")
+    cfg, state, box = sim._cfg, sim.state, sim.box
+    return EntryCase(
+        fn=lambda s, b: prop.step_hydro_ve(s, b, cfg, None),
+        args=(state, box),
+        lower=lambda: prop.step_hydro_ve_donated.lower(state, box, cfg,
+                                                       None),
+        carry=lambda a, out: (out[0], out[1]),
+    )
+
+
+@entrypoint("step_nbody", donate=(0,))
+def step_nbody():
+    from sphexa_tpu import propagator as prop
+
+    sim = _sim("evrard", _SIDE_GRAV, prop="nbody")
+    cfg, state, box, gtree = sim._cfg, sim.state, sim.box, sim._gtree
+    return EntryCase(
+        fn=lambda s, b, g: prop.step_nbody(s, b, cfg, g),
+        args=(state, box, gtree),
+        lower=lambda: prop.step_nbody_donated.lower(state, box, cfg, gtree),
+        carry=lambda a, out: (out[0], out[1], a[2]),
+    )
+
+
+@entrypoint("step_turb_ve", donate=(0,))
+def step_turb_ve():
+    from sphexa_tpu import propagator as prop
+
+    sim = _sim("turbulence", _SIDE, prop="turb-ve")
+    cfg, state, box = sim._cfg, sim.state, sim.box
+    turb_cfg, turb = sim.turb_cfg, sim.turb_state
+    return EntryCase(
+        fn=lambda s, b, t: prop.step_turb_ve(s, b, cfg, None, t, turb_cfg),
+        args=(state, box, turb),
+        lower=lambda: prop.step_turb_ve_donated.lower(
+            state, box, cfg, None, turb, turb_cfg),
+        carry=lambda a, out: (out[0], out[1], out[3]),
+    )
+
+
+@entrypoint("step_std_cooling", donate=(0,))
+def step_std_cooling():
+    from sphexa_tpu import propagator as prop
+
+    sim = _sim("evrard-cooling", _SIDE_GRAV, prop="std-cooling")
+    cfg, state, box, gtree = sim._cfg, sim.state, sim.box, sim._gtree
+    cool_cfg, chem = sim.cooling_cfg, sim.chem
+    return EntryCase(
+        fn=lambda s, b, g, ch: prop.step_hydro_std_cooling(
+            s, b, cfg, g, ch, cool_cfg),
+        args=(state, box, gtree, chem),
+        lower=lambda: prop.step_hydro_std_cooling_donated.lower(
+            state, box, cfg, gtree, chem, cool_cfg),
+        carry=lambda a, out: (out[0], out[1], a[2], out[3]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# gravity solve (gravity/traversal.py)
+# ---------------------------------------------------------------------------
+
+
+@entrypoint("gravity_solve")
+def gravity_solve():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sphexa_tpu import native
+    from sphexa_tpu.gravity.traversal import compute_gravity
+
+    sim = _sim("evrard", _SIDE_GRAV, prop="nbody")
+    s, box = sim.state, sim.box
+    keys = native.compute_keys(
+        np.asarray(s.x), np.asarray(s.y), np.asarray(s.z),
+        np.asarray(box.lo), np.asarray(box.lengths), sim.curve,
+    )
+    order = native.argsort_keys(keys)
+    skeys = jnp.asarray(keys[order])
+    xs, ys, zs, ms, hs = (
+        jnp.asarray(np.asarray(f)[order])
+        for f in (s.x, s.y, s.z, s.m, s.h)
+    )
+    meta, gcfg = sim._cfg.grav_meta, sim._cfg.gravity
+    return EntryCase(
+        fn=lambda x, y, z, m, h, sk, b, gt: compute_gravity(
+            x, y, z, m, h, sk, b, gt, meta, gcfg),
+        args=(xs, ys, zs, ms, hs, skeys, box, sim._gtree),
+    )
+
+
+# ---------------------------------------------------------------------------
+# sparse halo exchange (parallel/exchange.py) — sharded on the CPU mesh
+# ---------------------------------------------------------------------------
+
+
+@entrypoint("halo_exchange_sparse", mesh_axes=("p",))
+def halo_exchange_sparse():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec
+
+    from sphexa_tpu import native
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.parallel import exchange as ex
+    from sphexa_tpu.parallel import make_mesh
+    from sphexa_tpu.propagator import shard_map
+    from sphexa_tpu.simulation import make_propagator_config
+
+    if len(jax.devices()) < 2:
+        raise EntrySkip("needs >= 2 devices for the 'p' mesh "
+                        "(sphexa-audit bootstraps one; in-process callers "
+                        "use util.cpu_mesh.force_cpu_mesh)")
+    P = 2
+    state, box, const = make_initializer("sedov")(_SIDE)
+    cfg = make_propagator_config(state, box, const)
+    # globally SFC-sorted arrays, as the sharded step provides them
+    keys = native.compute_keys(
+        np.asarray(state.x), np.asarray(state.y), np.asarray(state.z),
+        np.asarray(box.lo), np.asarray(box.lengths), cfg.curve,
+    )
+    order = native.argsort_keys(keys)
+    skeys = jnp.asarray(keys[order])
+    x, y, z, h, m = (
+        jnp.asarray(np.asarray(f)[order])
+        for f in (state.x, state.y, state.z, state.h, state.m)
+    )
+    mesh = make_mesh(P)
+    S_shard = state.n // P
+    nbr = cfg.nbr
+    if nbr.run_cap > S_shard:  # same clamp as the sharded force stages
+        nbr = dataclasses.replace(nbr, run_cap=S_shard)
+    hmax = (S_shard,) * (P - 1)  # full per-distance coverage at tiny N
+
+    def stage(b, keys, x, y, z, h, m):
+        ranges, serve, jbuf, escaped = ex.shard_halo_stage_sparse(
+            x, y, z, h, keys, b, nbr, P, hmax, "p"
+        )
+        halo = serve((x, y, z, m))
+        jx, jy, jz, jm = jbuf((x, y, z, m), halo)
+        esc = jax.lax.pmax(
+            jnp.asarray(escaped, jnp.int32), "p"
+        )
+        return jx, jy, jz, jm, esc
+
+    Pp, Pr = PartitionSpec("p"), PartitionSpec()
+    fn = jax.jit(shard_map(
+        stage, mesh=mesh,
+        in_specs=(Pr, Pp, Pp, Pp, Pp, Pp, Pp),
+        out_specs=(Pp, Pp, Pp, Pp, Pr),
+        check_vma=False,
+    ))
+    return EntryCase(fn=fn, args=(box, skeys, x, y, z, h, m))
+
+
+# ---------------------------------------------------------------------------
+# tree build / sizing (parallel/sizing.py)
+# ---------------------------------------------------------------------------
+
+
+@entrypoint("tree_build_sizing")
+def tree_build_sizing():
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.parallel import sizing
+    from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+    state, box, const = make_initializer("sedov")(_SIDE)
+    level, group = 2, 64
+    keys = compute_sfc_keys(state.x, state.y, state.z, box)
+
+    def fn(x, y, z, b, keys):
+        occ, ext = sizing.sizing_stats(x, y, z, b, level, group)
+        hist = sizing.key_histogram(keys, level)
+        return occ, ext, hist
+
+    return EntryCase(fn=fn, args=(state.x, state.y, state.z, box, keys))

@@ -1,0 +1,10 @@
+"""Rule modules register themselves on import (core.register)."""
+
+from sphexa_tpu.devtools.audit.rules import (  # noqa: F401
+    jxa101_dtype_promotion,
+    jxa102_recompile,
+    jxa103_donation,
+    jxa104_host_boundary,
+    jxa105_const_bloat,
+    jxa106_collective_axes,
+)

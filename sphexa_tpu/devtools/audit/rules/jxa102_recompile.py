@@ -1,0 +1,95 @@
+"""JXA102: recompile-signature audit (step-2 retrace, weak-type drift).
+
+A jitted step recompiles whenever the abstract signature of its inputs
+changes — shape, dtype, OR weak_type. The classic silent version: step 1
+is fed a Python float (weak f32) or a host-built scalar, the step returns
+a committed strong-f32 array in that slot, and step 2 retraces the whole
+program — a one-time multi-second stall per reconfiguration that profiles
+as "mysterious slow second step" on real chips.
+
+Two sub-checks:
+
+- **carry**: entries with a ``carry`` (the step builders) run ONCE on the
+  example args; ``carry(args, out)`` rearranges the outputs into step-2
+  args, and the flattened aval signature (shape, dtype, weak_type) of
+  step-2 args must equal step-1's, leaf by leaf. Execution (not
+  eval_shape) is required: weak_type does not survive into
+  ShapeDtypeStruct, and weak-type drift is the main target.
+- **perturb**: entries with a ``perturb`` variant of the args (host-fed
+  Python scalars where the API tolerates either) are traced both ways;
+  the OUTPUT avals must match, proving the entry normalizes scalars
+  internally instead of letting caller-side weak types leak downstream.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import EntryTrace, register
+from sphexa_tpu.devtools.common import Finding
+
+
+def _signature(tree):
+    """[(path, aval_str)] over the flattened pytree, weak types visible."""
+    import jax
+    from jax.api_util import shaped_abstractify
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [
+        (jax.tree_util.keystr(path), str(shaped_abstractify(leaf)))
+        for path, leaf in leaves
+    ]
+
+
+@register(
+    "JXA102", "recompile-signature",
+    "step-2-shaped inputs or weak-type-perturbed scalars change the "
+    "trace signature (silent per-step recompile)",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    case = trace.case
+    out: List[Finding] = []
+
+    if case.carry is not None:
+        sig1 = _signature(case.args)
+        args2 = case.carry(case.args, trace.out)
+        sig2 = _signature(args2)
+        if len(sig1) != len(sig2):
+            out.append(trace.finding(
+                "JXA102",
+                f"carried step-2 args have {len(sig2)} leaves vs "
+                f"{len(sig1)} at step 1 — the pytree structure itself "
+                f"drifts, every step retraces.",
+            ))
+        else:
+            drift = [
+                (p1, a1, a2)
+                for (p1, a1), (_p2, a2) in zip(sig1, sig2)
+                if a1 != a2
+            ]
+            for path, a1, a2 in drift[:8]:
+                out.append(trace.finding(
+                    "JXA102",
+                    f"arg leaf {path or '<root>'} changes signature across "
+                    f"steps: {a1} (step 1) vs {a2} (step 2) — the second "
+                    f"step retraces. Commit the scalar to a policy dtype "
+                    f"where the state is built.",
+                ))
+
+    if case.perturb is not None:
+        import jax
+
+        canonical = jax.make_jaxpr(case.fn)(*case.args)
+        perturbed = jax.make_jaxpr(case.fn)(*case.perturb(case.args))
+        o1 = [str(a) for a in canonical.out_avals]
+        o2 = [str(a) for a in perturbed.out_avals]
+        if o1 != o2:
+            diffs = [f"{a} vs {b}" for a, b in zip(o1, o2) if a != b]
+            out.append(trace.finding(
+                "JXA102",
+                f"host-fed weak scalars leak into the outputs: "
+                f"{'; '.join(diffs[:4])} — normalize scalars "
+                f"(jnp.asarray(..., policy_dtype)) at the function "
+                f"boundary so callers can't perturb the signature.",
+            ))
+    return out

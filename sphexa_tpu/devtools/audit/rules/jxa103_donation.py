@@ -1,0 +1,75 @@
+"""JXA103: donation audit — declared-donatable buffers that aren't donated.
+
+The particle-state pytree is the MB-to-GB-scale resident of every step:
+without ``donate_argnums``/``donate_argnames`` XLA must double-buffer it
+(input + output live simultaneously), which halves the largest runnable N
+per chip and forfeits in-place update fusion. Registry entries declare
+which lowered argument positions hold such buffers (``donate=(0,)``);
+this rule lowers the entry's HOT variant and verifies every leaf of each
+declared position is actually donated.
+
+Indices count positions in ``Lowered.args_info`` — static args are
+elided there, so count only traced positionals of the lowering call.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import EntryTrace, register
+from sphexa_tpu.devtools.common import Finding
+
+
+def _leaf_bytes(aval) -> int:
+    shape = getattr(aval, "shape", ())
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    return math.prod(shape) * dtype.itemsize if shape else dtype.itemsize
+
+
+@register(
+    "JXA103", "donation",
+    "declared-donatable buffers (the particle-state pytree) not donated "
+    "in the entry's lowering",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    entry = trace.entry
+    if not entry.donate:
+        return []
+    lowered = trace.lowered
+    if lowered is None:
+        return [trace.finding(
+            "JXA103",
+            "entry declares donatable args but provides no `lower` thunk "
+            "— register `<fn>_donated.lower(*args)` so donation is "
+            "auditable.",
+        )]
+    import jax
+
+    args_info = lowered.args_info[0] if isinstance(
+        lowered.args_info, tuple) else lowered.args_info
+    out: List[Finding] = []
+    for idx in entry.donate:
+        if idx >= len(args_info):
+            out.append(trace.finding(
+                "JXA103",
+                f"declared donate index {idx} out of range for the "
+                f"lowering's {len(args_info)} traced args.",
+            ))
+            continue
+        leaves = jax.tree_util.tree_leaves(
+            args_info[idx], is_leaf=lambda x: hasattr(x, "donated")
+        )
+        missed = [l for l in leaves if not getattr(l, "donated", False)]
+        if missed:
+            lost = sum(_leaf_bytes(getattr(l, "aval", None)) for l in missed)
+            out.append(trace.finding(
+                "JXA103",
+                f"arg {idx}: {len(missed)}/{len(leaves)} leaves NOT "
+                f"donated ({lost} bytes double-buffered at example scale; "
+                f"scales with N). Add donate_argnames for this pytree on "
+                f"the hot jit (propagator step_*_donated pattern).",
+            ))
+    return out

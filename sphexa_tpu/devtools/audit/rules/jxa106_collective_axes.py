@@ -1,0 +1,61 @@
+"""JXA106: collective-axis audit against the entry's declared sharding.
+
+Every psum/ppermute/all_gather/... in the traced body names a mesh axis;
+the registry entry declares which axes its sharding provides
+(``mesh_axes=("p",)``). An axis outside the declaration means the code
+and the registry disagree about the mesh — either a renamed axis that a
+copy-pasted collective still references (it resolves fine against an
+unrelated axis of the same name on a larger mesh and reduces over the
+WRONG devices), or a collective that escaped into an entry registered as
+unsharded. shard_map eqns are cross-checked the same way: the mesh they
+bind must only carry declared axes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from sphexa_tpu.devtools.audit.core import (
+    EntryTrace,
+    register,
+    subjaxprs,
+)
+from sphexa_tpu.devtools.common import Finding
+
+_AXIS_PARAM_KEYS = ("axes", "axis_name")
+
+
+def _string_axes(value) -> List[str]:
+    vals = value if isinstance(value, (tuple, list)) else (value,)
+    return [v for v in vals if isinstance(v, str)]
+
+
+@register(
+    "JXA106", "collective-axis",
+    "collective over an axis name outside the entry's declared mesh "
+    "sharding",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    declared = set(trace.entry.mesh_axes)
+    unknown: Dict[str, str] = {}  # axis -> first primitive
+    for eqn in subjaxprs(trace.closed_jaxpr.jaxpr):
+        names: List[str] = []
+        for key in _AXIS_PARAM_KEYS:
+            if key in eqn.params:
+                names += _string_axes(eqn.params[key])
+        mesh = eqn.params.get("mesh")
+        if mesh is not None and hasattr(mesh, "axis_names"):
+            names += _string_axes(tuple(mesh.axis_names))
+        for name in names:
+            if name not in declared and name not in unknown:
+                unknown[name] = eqn.primitive.name
+    return [
+        trace.finding(
+            "JXA106",
+            f"`{prim}` uses axis {name!r} but the registry declares "
+            f"mesh_axes={tuple(sorted(declared))} for this entry — the "
+            f"code and the declared sharding disagree; fix the axis name "
+            f"or the registration.",
+        )
+        for name, prim in sorted(unknown.items())
+    ]

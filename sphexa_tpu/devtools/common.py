@@ -1,0 +1,275 @@
+"""Machinery shared by the devtools analyzers (jaxlint, jaxaudit).
+
+Both tools emit the same ``Finding`` shape, honor the same inline
+suppression grammar (``# <tool>: disable=XYZ123 -- reason``), consume the
+same snippet-hash baseline format, and render through the same text/JSON
+reporters. Factoring it here keeps the two gates behaviorally identical:
+a workflow learned on one tool (suppression reasons, baseline updates,
+exit codes) transfers verbatim to the other.
+
+Baseline entries key on (rule, path, snippet-hash) with a count — NOT on
+line numbers, so unrelated edits above a grandfathered site don't churn
+the file. Matching is consuming: N baselined copies of an identical line
+absorb at most N findings; the N+1st is new and fails the gate. The
+acceptance state for this repo is an EMPTY baseline for both tools.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import io
+import json
+import re
+import tokenize
+from collections import Counter
+from pathlib import Path
+from typing import Dict, List, Optional, Pattern, Tuple
+
+__all__ = [
+    "Finding",
+    "make_disable_re",
+    "SuppressionTable",
+    "parse_suppressions",
+    "Baseline",
+    "baseline_key",
+    "render_text",
+    "render_json",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str          # "JXL001" / "JXA103"
+    path: str          # posix path as given to the analyzer
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    snippet: str = ""  # stripped source line, for reports and baseline keys
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+
+def make_disable_re(tool: str) -> Pattern:
+    """Compiled ``# <tool>: disable[-file]=CODES [-- reason]`` directive."""
+    return re.compile(
+        rf"#\s*{re.escape(tool)}:\s*disable(?P<file>-file)?\s*=\s*"
+        r"(?P<codes>[A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+        r"(?:\s*--\s*(?P<reason>.*))?"
+    )
+
+
+@dataclasses.dataclass
+class SuppressionTable:
+    """Per-line and file-wide ``disable=`` directives.
+
+    A finding at line L is suppressed when its rule code appears in a
+    directive on line L itself, in a stand-alone comment in the run of
+    comment-only lines directly above L (plain explanatory comments in
+    the run don't break it), or in a ``disable-file=`` directive
+    anywhere in the file.
+    """
+
+    by_line: Dict[int, set]          # line -> {codes} (directive ON that line)
+    comment_only: Dict[int, set]     # comment-only DIRECTIVE lines
+    comment_lines: set               # ALL comment-only lines (any content)
+    file_wide: set
+
+    def is_suppressed(self, code: str, line: int) -> bool:
+        if code in self.file_wide:
+            return True
+        if code in self.by_line.get(line, ()):
+            return True
+        # run of comment-only lines directly above the finding
+        lookup = line - 1
+        while lookup in self.comment_lines:
+            if code in self.comment_only.get(lookup, ()):
+                return True
+            lookup -= 1
+        return False
+
+
+def parse_suppressions(source: str, directive_re: Pattern) -> SuppressionTable:
+    by_line: Dict[int, set] = {}
+    comment_only: Dict[int, set] = {}
+    comment_lines: set = set()
+    file_wide: set = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        line = tok.start[0]
+        standalone = tok.line[: tok.start[1]].strip() == ""
+        if standalone:
+            comment_lines.add(line)
+        m = directive_re.search(tok.string)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group("codes").split(",")}
+        if m.group("file"):
+            file_wide |= codes
+            continue
+        by_line.setdefault(line, set()).update(codes)
+        if standalone:
+            comment_only.setdefault(line, set()).update(codes)
+    return SuppressionTable(by_line, comment_only, comment_lines, file_wide)
+
+
+# ---------------------------------------------------------------------------
+# committed baseline of grandfathered findings
+# ---------------------------------------------------------------------------
+
+_VERSION = 1
+
+
+def baseline_key(f: Finding) -> Tuple[str, str, str]:
+    digest = hashlib.sha256(f.snippet.encode()).hexdigest()[:16]
+    return (f.rule, f.path, digest)
+
+
+@dataclasses.dataclass
+class Baseline:
+    entries: Counter  # (rule, path, snippet_hash) -> count
+
+    @classmethod
+    def empty(cls) -> "Baseline":
+        return cls(entries=Counter())
+
+    @classmethod
+    def from_findings(cls, findings: List[Finding]) -> "Baseline":
+        return cls(entries=Counter(baseline_key(f) for f in findings))
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        p = Path(path)
+        if not p.exists():
+            return cls.empty()
+        data = json.loads(p.read_text())
+        if data.get("version") != _VERSION:
+            raise ValueError(
+                f"baseline {path}: unsupported version {data.get('version')}"
+            )
+        entries: Counter = Counter()
+        for e in data.get("entries", []):
+            entries[(e["rule"], e["path"], e["snippet_hash"])] = int(
+                e.get("count", 1)
+            )
+        return cls(entries=entries)
+
+    def save(self, path: str) -> None:
+        entries = [
+            {"rule": r, "path": p, "snippet_hash": h, "count": c}
+            for (r, p, h), c in sorted(self.entries.items())
+            if c > 0
+        ]
+        Path(path).write_text(
+            json.dumps({"version": _VERSION, "entries": entries}, indent=2)
+            + "\n"
+        )
+
+    def filter_new(self, findings: List[Finding]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, grandfathered): consume baseline credit per finding."""
+        budget = Counter(self.entries)
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in findings:
+            k = baseline_key(f)
+            if budget[k] > 0:
+                budget[k] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+
+def render_text(new: List[Finding], grandfathered: List[Finding],
+                suppressed: List[Finding], errors: List[Finding],
+                tool: str, show_suppressed: bool = False) -> str:
+    lines: List[str] = []
+    for f in errors:
+        lines.append(f.format())
+    for f in new:
+        lines.append(f.format())
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    if show_suppressed:
+        for f in suppressed:
+            lines.append(f"[suppressed] {f.format()}")
+        for f in grandfathered:
+            lines.append(f"[baseline] {f.format()}")
+    n_new = len(new) + len(errors)
+    summary = (
+        f"{tool}: {n_new} finding(s)"
+        + (f", {len(grandfathered)} baselined" if grandfathered else "")
+        + (f", {len(suppressed)} suppressed inline" if suppressed else "")
+    )
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def render_json(new: List[Finding], grandfathered: List[Finding],
+                suppressed: List[Finding], errors: List[Finding]) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_json() for f in new],
+            "errors": [f.to_json() for f in errors],
+            "baselined": [f.to_json() for f in grandfathered],
+            "suppressed": [f.to_json() for f in suppressed],
+        },
+        indent=2,
+    )
+
+
+def finish_cli(prog: str, tool: str, args, active: List[Finding],
+               suppressed: List[Finding], errors: List[Finding]) -> int:
+    """Shared CLI tail for both analyzers: --update-baseline writing,
+    baseline filtering, text/JSON rendering, exit code. One copy so the
+    two gates' contracts (messages, exception handling, exit codes:
+    0 clean / 1 findings-or-errors / 2 usage) can never drift apart.
+
+    ``args`` needs the common argparse fields: baseline, update_baseline,
+    format, show_suppressed.
+    """
+    import sys
+
+    if args.update_baseline:
+        Baseline.from_findings(active).save(args.baseline)
+        print(f"{prog}: wrote {len(active)} entr"
+              f"{'y' if len(active) == 1 else 'ies'} to {args.baseline}")
+        return 0
+
+    try:
+        baseline = Baseline.load(args.baseline) if args.baseline \
+            else Baseline.empty()
+    except (ValueError, OSError) as e:
+        print(f"{prog}: cannot read baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+    new, grandfathered = baseline.filter_new(active)
+
+    if args.format == "json":
+        print(render_json(new, grandfathered, suppressed, errors))
+    else:
+        print(render_text(new, grandfathered, suppressed, errors,
+                          tool=tool, show_suppressed=args.show_suppressed))
+    return 1 if (new or errors) else 0

@@ -1,85 +1,12 @@
-"""Committed baseline of grandfathered findings.
+"""Committed baseline of grandfathered lint findings.
 
-Entries key on (rule, path, snippet-hash) with a count — NOT on line
-numbers, so unrelated edits above a grandfathered site don't churn the
-file. Matching is consuming: N baselined copies of an identical line
-absorb at most N findings; the N+1st is new and fails the gate.
-
-The acceptance state for this repo is an EMPTY baseline (every finding
-fixed or carrying an inline suppression with a reason); the mechanism
-exists so a future rule can land before its fix sweep completes.
+The format and the consuming (rule, path, snippet-hash) matching live in
+``devtools/common.py`` and are shared with the jaxaudit baseline; see the
+docstring there. The acceptance state for this repo is an EMPTY baseline
+(every finding fixed or carrying an inline suppression with a reason).
 """
 
 from __future__ import annotations
 
-import dataclasses
-import hashlib
-import json
-from collections import Counter
-from pathlib import Path
-from typing import Dict, List, Tuple
-
-from sphexa_tpu.devtools.lint.core import Finding
-
-_VERSION = 1
-
-
-def _key(f: Finding) -> Tuple[str, str, str]:
-    digest = hashlib.sha256(f.snippet.encode()).hexdigest()[:16]
-    return (f.rule, f.path, digest)
-
-
-@dataclasses.dataclass
-class Baseline:
-    entries: Counter  # (rule, path, snippet_hash) -> count
-
-    @classmethod
-    def empty(cls) -> "Baseline":
-        return cls(entries=Counter())
-
-    @classmethod
-    def from_findings(cls, findings: List[Finding]) -> "Baseline":
-        return cls(entries=Counter(_key(f) for f in findings))
-
-    @classmethod
-    def load(cls, path: str) -> "Baseline":
-        p = Path(path)
-        if not p.exists():
-            return cls.empty()
-        data = json.loads(p.read_text())
-        if data.get("version") != _VERSION:
-            raise ValueError(
-                f"baseline {path}: unsupported version {data.get('version')}"
-            )
-        entries: Counter = Counter()
-        for e in data.get("entries", []):
-            entries[(e["rule"], e["path"], e["snippet_hash"])] = int(
-                e.get("count", 1)
-            )
-        return cls(entries=entries)
-
-    def save(self, path: str) -> None:
-        entries = [
-            {"rule": r, "path": p, "snippet_hash": h, "count": c}
-            for (r, p, h), c in sorted(self.entries.items())
-            if c > 0
-        ]
-        Path(path).write_text(
-            json.dumps({"version": _VERSION, "entries": entries}, indent=2)
-            + "\n"
-        )
-
-    def filter_new(self, findings: List[Finding]
-                   ) -> Tuple[List[Finding], List[Finding]]:
-        """(new, grandfathered): consume baseline credit per finding."""
-        budget = Counter(self.entries)
-        new: List[Finding] = []
-        old: List[Finding] = []
-        for f in findings:
-            k = _key(f)
-            if budget[k] > 0:
-                budget[k] -= 1
-                old.append(f)
-            else:
-                new.append(f)
-        return new, old
+from sphexa_tpu.devtools.common import Baseline  # noqa: F401
+from sphexa_tpu.devtools.common import baseline_key as _key  # noqa: F401

@@ -16,9 +16,8 @@ import argparse
 import sys
 from typing import List, Optional
 
-from sphexa_tpu.devtools.lint.baseline import Baseline
+from sphexa_tpu.devtools.common import finish_cli
 from sphexa_tpu.devtools.lint.core import Analyzer, all_rules
-from sphexa_tpu.devtools.lint.reporter import render_json, render_text
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -69,28 +68,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     active, suppressed, errors = analyzer.run_paths(args.paths)
-
-    if args.update_baseline:
-        Baseline.from_findings(active).save(args.baseline)
-        print(f"sphexa-lint: wrote {len(active)} entr"
-              f"{'y' if len(active) == 1 else 'ies'} to {args.baseline}")
-        return 0
-
-    try:
-        baseline = Baseline.load(args.baseline) if args.baseline \
-            else Baseline.empty()
-    except (ValueError, OSError) as e:
-        print(f"sphexa-lint: cannot read baseline {args.baseline}: {e}",
-              file=sys.stderr)
-        return 2
-    new, grandfathered = baseline.filter_new(active)
-
-    if args.format == "json":
-        print(render_json(new, grandfathered, suppressed, errors))
-    else:
-        print(render_text(new, grandfathered, suppressed, errors,
-                          show_suppressed=args.show_suppressed))
-    return 1 if (new or errors) else 0
+    return finish_cli("sphexa-lint", "jaxlint", args, active, suppressed,
+                      errors)
 
 
 if __name__ == "__main__":

@@ -16,11 +16,17 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-import io
-import re
-import tokenize
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from sphexa_tpu.devtools.common import (
+    Finding,
+    SuppressionTable,
+    make_disable_re,
+)
+from sphexa_tpu.devtools.common import (
+    parse_suppressions as _parse_suppressions,
+)
 
 __all__ = [
     "Finding",
@@ -32,93 +38,14 @@ __all__ = [
     "lint_paths",
 ]
 
-
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    """One rule violation at one source location."""
-
-    rule: str          # "JXL001"
-    path: str          # posix path as given to the analyzer
-    line: int          # 1-based
-    col: int           # 0-based
-    message: str
-    snippet: str = ""  # stripped source line, for reports and baseline keys
-
-    def format(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
-
-    def to_json(self) -> dict:
-        return dataclasses.asdict(self)
-
-
-# ---------------------------------------------------------------------------
-# suppression comments
-# ---------------------------------------------------------------------------
-
-_DISABLE_RE = re.compile(
-    r"#\s*jaxlint:\s*disable(?P<file>-file)?\s*=\s*"
-    r"(?P<codes>[A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
-    r"(?:\s*--\s*(?P<reason>.*))?"
-)
-
-
-@dataclasses.dataclass
-class SuppressionTable:
-    """Per-line and file-wide ``# jaxlint: disable=`` directives.
-
-    A finding at line L is suppressed when its rule code appears in a
-    directive on line L itself, in a stand-alone comment in the run of
-    comment-only lines directly above L (plain explanatory comments in
-    the run don't break it), or in a ``disable-file=`` directive
-    anywhere in the file.
-    """
-
-    by_line: Dict[int, set]          # line -> {codes} (directive ON that line)
-    comment_only: Dict[int, set]     # comment-only DIRECTIVE lines
-    comment_lines: set               # ALL comment-only lines (any content)
-    file_wide: set
-
-    def is_suppressed(self, code: str, line: int) -> bool:
-        if code in self.file_wide:
-            return True
-        if code in self.by_line.get(line, ()):
-            return True
-        # run of comment-only lines directly above the finding
-        lookup = line - 1
-        while lookup in self.comment_lines:
-            if code in self.comment_only.get(lookup, ()):
-                return True
-            lookup -= 1
-        return False
+# the Finding / SuppressionTable / Baseline machinery is shared with the
+# trace-level auditor (devtools/common.py); only the directive tool name
+# differs between the two gates
+_DISABLE_RE = make_disable_re("jaxlint")
 
 
 def parse_suppressions(source: str) -> SuppressionTable:
-    by_line: Dict[int, set] = {}
-    comment_only: Dict[int, set] = {}
-    comment_lines: set = set()
-    file_wide: set = set()
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        tokens = []
-    for tok in tokens:
-        if tok.type != tokenize.COMMENT:
-            continue
-        line = tok.start[0]
-        standalone = tok.line[: tok.start[1]].strip() == ""
-        if standalone:
-            comment_lines.add(line)
-        m = _DISABLE_RE.search(tok.string)
-        if not m:
-            continue
-        codes = {c.strip() for c in m.group("codes").split(",")}
-        if m.group("file"):
-            file_wide |= codes
-            continue
-        by_line.setdefault(line, set()).update(codes)
-        if standalone:
-            comment_only.setdefault(line, set()).update(codes)
-    return SuppressionTable(by_line, comment_only, comment_lines, file_wide)
+    return _parse_suppressions(source, _DISABLE_RE)
 
 
 # ---------------------------------------------------------------------------

@@ -1,46 +1,10 @@
-"""Text and JSON reporters for lint results."""
+"""Text and JSON reporters for lint results (shared devtools renderers)."""
 
 from __future__ import annotations
 
-import json
-from typing import List, Optional
+import functools
 
-from sphexa_tpu.devtools.lint.core import Finding
+from sphexa_tpu.devtools.common import render_json  # noqa: F401
+from sphexa_tpu.devtools.common import render_text as _render_text
 
-
-def render_text(new: List[Finding], grandfathered: List[Finding],
-                suppressed: List[Finding], errors: List[Finding],
-                show_suppressed: bool = False) -> str:
-    lines: List[str] = []
-    for f in errors:
-        lines.append(f.format())
-    for f in new:
-        lines.append(f.format())
-        if f.snippet:
-            lines.append(f"    {f.snippet}")
-    if show_suppressed:
-        for f in suppressed:
-            lines.append(f"[suppressed] {f.format()}")
-        for f in grandfathered:
-            lines.append(f"[baseline] {f.format()}")
-    n_new = len(new) + len(errors)
-    summary = (
-        f"jaxlint: {n_new} finding(s)"
-        + (f", {len(grandfathered)} baselined" if grandfathered else "")
-        + (f", {len(suppressed)} suppressed inline" if suppressed else "")
-    )
-    lines.append(summary)
-    return "\n".join(lines)
-
-
-def render_json(new: List[Finding], grandfathered: List[Finding],
-                suppressed: List[Finding], errors: List[Finding]) -> str:
-    return json.dumps(
-        {
-            "findings": [f.to_json() for f in new],
-            "errors": [f.to_json() for f in errors],
-            "baselined": [f.to_json() for f in grandfathered],
-            "suppressed": [f.to_json() for f in suppressed],
-        },
-        indent=2,
-    )
+render_text = functools.partial(_render_text, tool="jaxlint")

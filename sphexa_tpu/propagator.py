@@ -599,8 +599,7 @@ def _std_forces(
             rho, c, gdiag, aux)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def step_hydro_std(
+def _step_hydro_std(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree] = None, lists=None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
@@ -618,8 +617,7 @@ def step_hydro_std(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "cool_cfg"))
-def step_hydro_std_cooling(
+def _step_hydro_std_cooling(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree], chem, cool_cfg, lists=None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array], object]:
@@ -790,8 +788,7 @@ def _ve_forces(
     return state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def step_hydro_ve(
+def _step_hydro_ve(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree] = None, lists=None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
@@ -812,8 +809,7 @@ def step_hydro_ve(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "turb_cfg"))
-def step_turb_ve(
+def _step_turb_ve(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree], turb, turb_cfg, lists=None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array], object]:
@@ -836,8 +832,7 @@ def step_turb_ve(
     return new_state, box, diag, turb
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def step_nbody(
+def _step_nbody(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
     gtree: Optional[GravityTree] = None,
 ) -> Tuple[ParticleState, Box, Dict[str, jax.Array]]:
@@ -862,3 +857,39 @@ def step_nbody(
         extra_diag={**gdiag, "egrav": egrav}, update_smoothing=False,
         keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields,
     )
+
+
+# ---------------------------------------------------------------------------
+# jitted step variants
+# ---------------------------------------------------------------------------
+# Every step builder ships as a PAIR of jits over the same impl:
+#
+# - the plain variant keeps every input alive: the Simulation's
+#   discard-and-replay contract (cap overflow, expired lists, deferred
+#   rollback) re-launches from the SAME state object, so the checked path
+#   must never consume its input;
+# - the ``*_donated`` twin donates the particle-state pytree, letting XLA
+#   alias the step's output into the input buffers — no double-buffering
+#   of the MB/GB-scale state, which is what bounds the largest runnable N
+#   per chip. It is only launched on paths that can never need the input
+#   again (Simulation deferred happy-path windows, which pin a COPY for
+#   rollback) and is the variant the jaxaudit donation rule (JXA103)
+#   holds the registry to.
+
+
+def _step_pair(impl, static):
+    plain = jax.jit(impl, static_argnames=static)
+    donated = jax.jit(impl, static_argnames=static,
+                      donate_argnames=("state",))
+    return plain, donated
+
+
+step_hydro_std, step_hydro_std_donated = _step_pair(
+    _step_hydro_std, ("cfg",))
+step_hydro_std_cooling, step_hydro_std_cooling_donated = _step_pair(
+    _step_hydro_std_cooling, ("cfg", "cool_cfg"))
+step_hydro_ve, step_hydro_ve_donated = _step_pair(
+    _step_hydro_ve, ("cfg",))
+step_turb_ve, step_turb_ve_donated = _step_pair(
+    _step_turb_ve, ("cfg", "turb_cfg"))
+step_nbody, step_nbody_donated = _step_pair(_step_nbody, ("cfg",))

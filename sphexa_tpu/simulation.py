@@ -24,9 +24,14 @@ from sphexa_tpu.propagator import (
     PropagatorConfig,
     step_hydro_std,
     step_hydro_std_cooling,
+    step_hydro_std_cooling_donated,
+    step_hydro_std_donated,
     step_hydro_ve,
+    step_hydro_ve_donated,
     step_nbody,
+    step_nbody_donated,
     step_turb_ve,
+    step_turb_ve_donated,
 )
 from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
@@ -37,6 +42,18 @@ _PROPAGATORS: Dict[str, Callable] = {
     "nbody": step_nbody,
     "turb-ve": step_turb_ve,
     "std-cooling": step_hydro_std_cooling,
+}
+
+# donated twins (propagator._step_pair): the particle-state pytree is
+# consumed in place — ONLY safe on launch paths that can never need the
+# input again (the deferred happy-path window, which pins a copy for
+# rollback); the checked/replay paths always use _PROPAGATORS
+_PROPAGATORS_DONATED: Dict[str, Callable] = {
+    "std": step_hydro_std_donated,
+    "ve": step_hydro_ve_donated,
+    "nbody": step_nbody_donated,
+    "turb-ve": step_turb_ve_donated,
+    "std-cooling": step_hydro_std_cooling_donated,
 }
 
 
@@ -190,6 +207,23 @@ def make_propagator_config(
     )
 
 
+def _dealias_leaves(tree):
+    """Copy pytree leaves that are the SAME array object as an earlier
+    leaf, so the whole tree is donatable (XLA: `f(donate(a), donate(a))`
+    is an error)."""
+    seen = set()
+
+    def fix(a):
+        if not hasattr(a, "ndim"):
+            return a
+        if id(a) in seen:
+            return jnp.copy(a)
+        seen.add(id(a))
+        return a
+
+    return jax.tree.map(fix, tree)
+
+
 class Simulation:
     """Owns state + static configs; reconfigures (recompiles) only when the
     cell grid no longer covers the interaction radius or a cell overflows
@@ -221,6 +255,8 @@ class Simulation:
         list_skin_rel: float = 0.2,
         halo_mode: str = "sparse",
         m2p_cap_margin: float = 1.3,
+        donate: object = "auto",
+        debug_checks: bool = False,
     ):
         self.state = state
         self.box = box
@@ -251,6 +287,37 @@ class Simulation:
             raise ValueError(f"halo_mode must be sparse|windowed, got "
                              f"{halo_mode!r}")
         self._halo_mode = halo_mode
+        # buffer donation (propagator step_*_donated): the deferred
+        # happy-path windows launch the donated twins so XLA aliases the
+        # step output into the input state buffers (no double-buffering
+        # of the dominant allocation). "auto" engages on TPU only — CPU
+        # honors donation too, but tier-1 discard-and-replay semantics
+        # are pinned to the undonated path there; donate=True opts in
+        # anywhere (the rollback pin becomes a copy, see step()).
+        if donate not in ("auto", True, False):
+            raise ValueError(f"donate must be 'auto'|True|False, got "
+                             f"{donate!r}")
+        self._donate_active = donate is True or (
+            donate == "auto" and jax.default_backend() == "tpu"
+        )
+        # runtime sanitizer (--debug-checks): the step runs under
+        # jax.experimental.checkify with NaN/Inf + out-of-bounds-index
+        # checks; the first triggered check is surfaced through the step
+        # diagnostics as ``check_error``. Synchronous checking only (the
+        # sanitizer exists to LOCALIZE failures, deferral would smear
+        # them across a window), lists/donation fast paths disabled.
+        self.debug_checks = bool(debug_checks)
+        self._check_err = None
+        self._checked_cache: Dict = {}
+        if self.debug_checks:
+            if num_devices is not None and num_devices > 1:
+                raise ValueError(
+                    "debug_checks is single-device (wrap the sharded "
+                    "stepper is future work); drop num_devices or the flag"
+                )
+            check_every = 1
+            use_lists = False
+            self._donate_active = False
         if num_devices is not None and num_devices > 1:
             from sphexa_tpu.parallel import make_mesh, shard_state
 
@@ -261,6 +328,18 @@ class Simulation:
                 )
             self._mesh = make_mesh(num_devices)
             self.state = shard_state(state, self._mesh)
+            # donation is wired on the single-device launch paths only;
+            # the sharded stepper (make_sharded_step) owns its own jit
+            self._donate_active = False
+        if self._donate_active:
+            # take ownership: donated launches consume state buffers in
+            # place, and the INITIAL state belongs to the caller (tests
+            # and restart flows reuse it) — one construction-time copy
+            # keeps the caller's arrays alive
+            self.state = jax.tree.map(
+                lambda a: jnp.copy(a) if hasattr(a, "ndim") else a,
+                self.state,
+            )
         if prop == "nbody" and const.g == 0.0:
             raise ValueError(
                 "prop='nbody' needs a gravitational constant: set SimConstants(g=...)"
@@ -606,10 +685,64 @@ class Simulation:
             )
         return out
 
-    def _launch(self):
+    def _checkified_step(self):
+        """jit(checkify(step)) with the static configs closed over —
+        rebuilt whenever the active config changes (reconfigure), cached
+        otherwise so steady debug steps reuse one executable."""
+        from jax.experimental import checkify
+
+        key = (self.prop_name, self._cfg, self.turb_cfg, self.cooling_cfg)
+        if self._checked_cache.get("key") != key:
+            step_fn = _PROPAGATORS[self.prop_name]
+            cfg = self._cfg
+            if self.prop_name == "turb-ve":
+                aux_cfg = self.turb_cfg
+                base = lambda s, b, g, aux: step_fn(s, b, cfg, g, aux,
+                                                    aux_cfg)
+            elif self.prop_name == "std-cooling":
+                aux_cfg = self.cooling_cfg
+                base = lambda s, b, g, aux: step_fn(s, b, cfg, g, aux,
+                                                    aux_cfg)
+            else:
+                base = lambda s, b, g, aux: step_fn(s, b, cfg, g)
+            errors = checkify.float_checks | checkify.index_checks
+            self._checked_cache = {
+                "key": key,
+                "fn": jax.jit(checkify.checkify(base, errors=errors)),
+            }
+        return self._checked_cache["fn"]
+
+    def _launch_debug(self):
+        """Sanitizer-mode launch: run the checkified step and stash the
+        checkify Error for _step_checked to surface."""
+        aux = None
+        if self.prop_name == "turb-ve":
+            aux = self.turb_state
+        elif self.prop_name == "std-cooling":
+            aux = self.chem
+        self._check_err, out = self._checkified_step()(
+            self.state, self.box, self._gtree, aux
+        )
+        if self.prop_name == "turb-ve":
+            new_state, new_box, diagnostics, new_turb = out
+            return new_state, new_box, diagnostics, new_turb, None
+        if self.prop_name == "std-cooling":
+            new_state, new_box, diagnostics, new_chem = out
+            return new_state, new_box, diagnostics, None, new_chem
+        new_state, new_box, diagnostics = out
+        return new_state, new_box, diagnostics, None, None
+
+    def _launch(self, donate_ok: bool = False):
         """Dispatch one jitted step on the current state (no host sync
         beyond the CPU-mesh drain). Returns (new_state, new_box,
-        diagnostics, new_turb, new_chem)."""
+        diagnostics, new_turb, new_chem).
+
+        ``donate_ok``: the caller guarantees it will never need the
+        CURRENT input state again (deferred happy-path windows pin a
+        rollback copy first) — with donation active, launch the donated
+        twin so the state is updated in place."""
+        if self.debug_checks:
+            return self._launch_debug()
         if self._mesh is not None:
             if self.prop_name == "turb-ve":
                 new_state, new_box, diagnostics, new_turb = self._drain(
@@ -629,7 +762,16 @@ class Simulation:
                 self._stepper(self.state, self.box, self._gtree)
             )
             return new_state, new_box, diagnostics, None, None
-        step_fn = _PROPAGATORS[self.prop_name]
+        donate_now = donate_ok and self._donate_active
+        if donate_now:
+            # freshly-built states alias leaves (build_state shares one
+            # zeros array across temp_lo/du/du_m1; restarts may too) and
+            # XLA refuses to donate the same buffer twice — copy the
+            # duplicates once (step outputs are always distinct, so this
+            # only ever pays on the first donated launch of a state)
+            self.state = _dealias_leaves(self.state)
+        step_fn = (_PROPAGATORS_DONATED[self.prop_name] if donate_now
+                   else _PROPAGATORS[self.prop_name])
         new_turb, new_chem = None, None
         kw = {}
         if self._use_lists:
@@ -748,6 +890,13 @@ class Simulation:
             for k, v in diagnostics.items()
         }
         result["reconfigured"] = float(reconfigured)
+        if self.debug_checks:
+            # first triggered checkify predicate of THIS step ("" = all
+            # NaN/Inf/OOB checks passed); .get() syncs, which is the
+            # sanitizer's contract — locate the failing step exactly
+            msg = self._check_err.get() if self._check_err is not None \
+                else None
+            result["check_error"] = msg or ""
         self._last_diag = result
         return result
 
@@ -768,10 +917,16 @@ class Simulation:
             return self._step_checked()
         if not self._pending:
             # only the WINDOW-START state is pinned for rollback (one
-            # extra state, not check_every of them — 68 MB/state at 100^3)
-            self._window_prior = (self.state, self.box, self.turb_state,
+            # extra state, not check_every of them — 68 MB/state at 100^3).
+            # With donation active the window's first launch CONSUMES
+            # self.state, so the pin must be a real copy — one copy per
+            # window, amortized over check_every donated steps
+            pin = self.state
+            if self._donate_active:
+                pin = jax.tree.map(jnp.copy, self.state)
+            self._window_prior = (pin, self.box, self.turb_state,
                                   self.chem, self.iteration)
-        out = self._launch()
+        out = self._launch(donate_ok=True)
         self._apply(out)
         self.iteration += 1
         self._pending.append(out[2])

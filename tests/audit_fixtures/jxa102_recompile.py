@@ -1,0 +1,57 @@
+"""JXA102 fixtures: signature drift across steps / weak-type leaks.
+
+``bad_dtype_carry``: the carried scalar comes back bf16 — step 2's input
+signature differs from step 1's and the whole step retraces.
+``bad_weak_leak``: a host-fed Python float (weak f32) flows straight to
+an output; a caller feeding outputs back (or logging them into state)
+inherits the weak/strong flip-flop. ``clean_normalized`` pins the scalar
+to the policy dtype at the boundary, so both probes pass.
+"""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+
+@entrypoint("bad_dtype_carry")  # expect: JXA102
+def bad_dtype_carry():
+    def fn(x, t):
+        return x * 2.0, (t + 1.0).astype(jnp.bfloat16)
+
+    return EntryCase(
+        fn=fn,
+        args=(jnp.zeros(4, jnp.float32), jnp.float32(0.0)),
+        carry=lambda a, out: (out[0], out[1]),
+    )
+
+
+@entrypoint("bad_weak_leak")  # expect: JXA102
+def bad_weak_leak():
+    def fn(x, s):
+        return x.sum(), s * 2.0
+
+    def perturb(args):
+        return (args[0], 3.0)  # host-fed Python float: weak f32
+
+    return EntryCase(
+        fn=fn,
+        args=(jnp.zeros(4, jnp.float32), jnp.float32(3.0)),
+        perturb=perturb,
+    )
+
+
+@entrypoint("clean_normalized")
+def clean_normalized():
+    def fn(x, s):
+        s = jnp.asarray(s, jnp.float32)  # boundary normalization
+        return x.sum(), s * 2.0
+
+    def perturb(args):
+        return (args[0], 3.0)
+
+    return EntryCase(
+        fn=fn,
+        args=(jnp.zeros(4, jnp.float32), jnp.float32(3.0)),
+        carry=lambda a, out: (a[0], out[1]),
+        perturb=perturb,
+    )

@@ -1,0 +1,32 @@
+"""JXA103 fixtures: a declared-donatable state pytree left undonated
+(the double-buffering miss) vs the donated twin pattern."""
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+
+def _step(state, k):
+    return jax.tree.map(lambda a: a * k, state), k * 1.0
+
+
+def _state():
+    return {"x": jnp.zeros(16), "y": jnp.ones(16)}
+
+
+@entrypoint("undonated_state", donate=(0,))  # expect: JXA103
+def undonated_state():
+    jitted = jax.jit(_step)
+    args = (_state(), jnp.float32(2.0))
+    return EntryCase(fn=jitted, args=args,
+                     lower=lambda: jitted.lower(*args))
+
+
+@entrypoint("donated_state", donate=(0,))
+def donated_state():
+    plain = jax.jit(_step)
+    donated = jax.jit(_step, donate_argnums=(0,))
+    args = (_state(), jnp.float32(2.0))
+    return EntryCase(fn=plain, args=args,
+                     lower=lambda: donated.lower(*args))

@@ -1,0 +1,50 @@
+"""JXA104 fixtures: host-boundary leaks in the traced body (a debug
+print left in a hot function, a per-step pure_callback), plus an
+inline-suppressed deliberate probe."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+
+@entrypoint("debug_print_in_body")  # expect: JXA104
+def debug_print_in_body():
+    def fn(x):
+        jax.debug.print("x0 = {}", x[0])
+        return x * 2.0
+
+    return EntryCase(fn=fn, args=(jnp.zeros(4),))
+
+
+@entrypoint("callback_in_body")  # expect: JXA104
+def callback_in_body():
+    def fn(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v) * 2.0,
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x,
+        )
+        return y + 1.0
+
+    return EntryCase(fn=fn, args=(jnp.zeros(4),))
+
+
+@entrypoint("clean_device_only")
+def clean_device_only():
+    def fn(x):
+        # np-constant staging (device_put with no target) must NOT fire
+        table = jnp.asarray(np.arange(8, dtype=np.float32))
+        return x + table.sum()
+
+    return EntryCase(fn=fn, args=(jnp.zeros(4),))
+
+
+# jaxaudit: disable=JXA104 -- deliberate probe: fixture for the suppression path
+@entrypoint("suppressed_debug_print")
+def suppressed_debug_print():
+    def fn(x):
+        jax.debug.print("probe {}", x[0])
+        return x
+
+    return EntryCase(fn=fn, args=(jnp.zeros(4),))

@@ -1,0 +1,25 @@
+"""JXA105 fixtures: an oversized host table baked into the jaxpr by
+closure vs the same data passed as an argument."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+_TABLE = np.arange(4096, dtype=np.float32)  # 16 KiB
+
+
+@entrypoint("baked_table", const_bytes_limit=1024)  # expect: JXA105
+def baked_table():
+    def fn(x):
+        return x + jnp.asarray(_TABLE)[: x.shape[0]]
+
+    return EntryCase(fn=fn, args=(jnp.zeros(4),))
+
+
+@entrypoint("table_as_argument", const_bytes_limit=1024)
+def table_as_argument():
+    def fn(x, table):
+        return x + table[: x.shape[0]]
+
+    return EntryCase(fn=fn, args=(jnp.zeros(4), jnp.asarray(_TABLE)))

@@ -1,0 +1,33 @@
+"""JXA106 fixtures: a collective whose axis disagrees with the entry's
+declared mesh sharding (code says 'p', registration says 'data') vs the
+consistent declaration."""
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, EntrySkip, entrypoint
+
+
+def _psum_fn():
+    from jax.sharding import PartitionSpec as P
+
+    from sphexa_tpu.parallel import make_mesh
+    from sphexa_tpu.propagator import shard_map
+
+    if len(jax.devices()) < 2:
+        raise EntrySkip("needs >= 2 devices for the fixture mesh")
+    mesh = make_mesh(2)
+    return jax.jit(shard_map(
+        lambda x: jax.lax.psum(x, "p"),
+        mesh=mesh, in_specs=P("p"), out_specs=P(), check_vma=False,
+    ))
+
+
+@entrypoint("wrong_axis_declaration", mesh_axes=("data",))  # expect: JXA106
+def wrong_axis_declaration():
+    return EntryCase(fn=_psum_fn(), args=(jnp.zeros(8),))
+
+
+@entrypoint("matching_axis_declaration", mesh_axes=("p",))
+def matching_axis_declaration():
+    return EntryCase(fn=_psum_fn(), args=(jnp.zeros(8),))
