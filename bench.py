@@ -156,13 +156,20 @@ def _gravity_scale_line(n=1_000_000):
 def main() -> int:
     from sphexa_tpu.init import init_evrard, init_sedov
     from sphexa_tpu.simulation import Simulation
+    from sphexa_tpu.telemetry import Telemetry
+    from sphexa_tpu.telemetry.manifest import build_manifest
+
+    # sink-less registry shared by every benched Simulation: counters
+    # (retraces/rollbacks) ride into the JSON so a bench line carries its
+    # own health record, not just a throughput number
+    tel = Telemetry()
 
     n = SIDE**3
     state, box, const = init_sedov(SIDE)
     # deferred cap-checking: the happy path issues no device->host sync
     # per step (diagnostics checked in one batch at the window end)
     sim = Simulation(state, box, const, prop="std", block=8192,
-                     check_every=STEPS)
+                     check_every=STEPS, telemetry=tel)
     std_ups = _measure(sim, n, STEPS)
     if std_ups is None:
         print("bench: no reconfigure-free window in 3 attempts", file=sys.stderr)
@@ -173,7 +180,7 @@ def main() -> int:
         n_aux = AUX_SIDE**3
         state, box, const = init_sedov(AUX_SIDE)
         sim = Simulation(state, box, const, prop="ve", block=8192,
-                         check_every=AUX_STEPS)
+                         check_every=AUX_STEPS, telemetry=tel)
         ve_ups = _measure(sim, n_aux, AUX_STEPS)
         if ve_ups:
             extra["ve_updates_per_sec"] = round(ve_ups, 1)
@@ -184,7 +191,7 @@ def main() -> int:
     try:
         state, box, const = init_evrard(AUX_SIDE)
         sim = Simulation(state, box, const, prop="ve", block=8192,
-                         check_every=AUX_STEPS)
+                         check_every=AUX_STEPS, telemetry=tel)
         nev = int(state.n)
         veg_ups = _measure(sim, nev, AUX_STEPS)
         if veg_ups:
@@ -206,9 +213,20 @@ def main() -> int:
     except Exception as e:
         print(f"bench: gravity-scale line failed: {e}", file=sys.stderr)
 
+    # per-run health counters from the shared registry (a clean bench
+    # window should show retraces only from first compiles; the
+    # reconfigures counter excludes each Simulation's initial sizing)
+    extra["telemetry"] = {
+        "retraces": int(tel.counters.get("retraces", 0)),
+        "rollbacks": int(tel.counters.get("rollbacks", 0)),
+        "reconfigures": int(tel.counters.get("reconfigures", 0)),
+    }
+
     # measured breakdowns/commentary live in docs/NEXT.md, labeled with the
     # hardware + commit they were taken on — repeating them here would
-    # assert stale numbers on every future run
+    # assert stale numbers on every future run. The manifest stamp makes
+    # bench rounds diffable (`sphexa-telemetry diff BENCH_rA.json
+    # BENCH_rB.json`) — existing keys stay byte-compatible.
     print(
         json.dumps(
             {
@@ -217,6 +235,12 @@ def main() -> int:
                 "unit": "particles/s",
                 "vs_baseline": round(std_ups / BASELINE_UPDATES_PER_SEC, 4),
                 "extra": extra,
+                "manifest": build_manifest(
+                    config={"side": SIDE, "steps": STEPS,
+                            "aux_side": AUX_SIDE, "aux_steps": AUX_STEPS,
+                            "block": 8192, "prop": "std"},
+                    particles=n,
+                ),
             }
         )
     )

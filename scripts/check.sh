@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# Repo gate: jaxlint (AST) -> jaxaudit (trace) -> tier-1 tests — what CI
-# (and a pre-push hook) runs.
+# Repo gate: jaxlint (AST) -> jaxaudit (trace) -> telemetry smoke ->
+# tier-1 tests — what CI (and a pre-push hook) runs.
 #
-#   scripts/check.sh              # lint + audit + fast tier
+#   scripts/check.sh                  # lint + audit + telemetry + fast tier
 #   scripts/check.sh --lint-only
 #   scripts/check.sh --audit-only
+#   scripts/check.sh --telemetry-only
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -34,6 +35,30 @@ run_audit() {
     fi
 }
 
+run_telemetry() {
+    echo "== telemetry smoke (5-step run -> sphexa-telemetry summary --strict) =="
+    local dir rc
+    dir=$(mktemp -d)
+    env JAX_PLATFORMS=cpu python -m sphexa_tpu.app.main \
+        --init sedov -n 8 -s 5 --quiet \
+        --telemetry-dir "$dir/run" -o "$dir/out"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "telemetry smoke run failed (rc=$rc)"
+        rm -rf "$dir"
+        exit $rc
+    fi
+    # --strict: every event must validate against the v1 schema
+    python -m sphexa_tpu.telemetry summary "$dir/run" --strict
+    rc=$?
+    rm -rf "$dir"
+    if [ $rc -ne 0 ]; then
+        echo "sphexa-telemetry summary failed (rc=$rc); schema drift or"
+        echo "missing events — see docs/OBSERVABILITY.md."
+        exit $rc
+    fi
+}
+
 case "${1:-}" in
     --lint-only)
         run_lint
@@ -43,10 +68,15 @@ case "${1:-}" in
         run_audit
         exit 0
         ;;
+    --telemetry-only)
+        run_telemetry
+        exit 0
+        ;;
 esac
 
 run_lint
 run_audit
+run_telemetry
 
 echo "== tier-1 tests (fast tier, CPU) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -66,6 +66,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "final snapshot before exiting if -w is enabled")
     p.add_argument("--profile", action="store_true",
                    help="save a per-iteration timing series to profile.npz")
+    p.add_argument("--telemetry-dir", default=None, dest="telemetry_dir",
+                   help="write structured run telemetry (manifest.json + "
+                        "events.jsonl) to this directory; summarize/diff "
+                        "it with sphexa-telemetry (docs/OBSERVABILITY.md)")
+    p.add_argument("--trace-dir", default=None, dest="trace_dir",
+                   help="capture a jax.profiler trace of the run into "
+                        "this directory (launch/flush/reconfigure scopes "
+                        "are TraceAnnotation-named); view with "
+                        "tensorboard/xprof")
     p.add_argument("--devices", type=int, default=None,
                    help="shard the run over N devices (SFC-slab domain "
                         "decomposition; default: single device)")
@@ -257,6 +266,17 @@ def main(argv=None) -> int:
         from sphexa_tpu.physics.cooling import CoolingConfig
 
         cooling_cfg = CoolingConfig(gamma=const.gamma, evolve_species=True)
+
+    # telemetry registry shared by the driver, the loop Timer and the
+    # profile series; --telemetry-dir adds the persisted JSONL sink (the
+    # sink-less registry costs counters only)
+    from sphexa_tpu.telemetry import JsonlSink, Telemetry
+
+    sinks = []
+    if args.telemetry_dir:
+        sinks.append(JsonlSink(os.path.join(args.telemetry_dir,
+                                            "events.jsonl")))
+    telemetry = Telemetry(sinks=sinks)
     try:
         sim = Simulation(state, box, const, prop=args.prop,
                          av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
@@ -265,10 +285,24 @@ def main(argv=None) -> int:
                          keep_fields=observable.needs_fields, theta=args.theta,
                          m2p_cap_margin=args.m2p_cap_margin,
                          num_devices=args.devices, halo_mode=args.halo_mode,
-                         debug_checks=args.debug_checks)
+                         debug_checks=args.debug_checks, telemetry=telemetry)
     except (NotImplementedError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2
+    if args.telemetry_dir:
+        from sphexa_tpu.telemetry import write_manifest
+
+        mesh = getattr(sim, "_mesh", None)
+        write_manifest(
+            args.telemetry_dir,
+            config={k: v for k, v in vars(args).items()
+                    if isinstance(v, (str, int, float, bool, type(None)))},
+            particles=state.n,
+            mesh_shape=tuple(mesh.devices.shape) if mesh is not None
+            else None,
+            extra={"case": case_name or args.init, "prop": args.prop},
+        )
+        log(f"# telemetry -> {args.telemetry_dir}")
     log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
 
     # resuming from a snapshot continues the iteration numbering, and an
@@ -428,7 +462,7 @@ def main(argv=None) -> int:
 
     from sphexa_tpu.util.timer import ProfileRecorder, Timer
 
-    timer = Timer()
+    timer = Timer(telemetry=telemetry)
     # in-situ viz adaptor: init before the loop, execute per iteration,
     # finalize after (sphexa.cpp:141-142,172,179 hook points)
     insitu = None
@@ -446,45 +480,64 @@ def main(argv=None) -> int:
     profile = ProfileRecorder()
     t0 = time.time()
     it0 = sim.iteration
-    while True:
-        timer.start()
-        d = sim.step()
-        timer.step("step")
-        it = sim.iteration
-        if args.debug_checks and d.get("check_error"):
-            print(f"# debug-checks it {it}: {d['check_error']}",
-                  file=sys.stderr)
-        e = conserved_quantities(sim.state, const, egrav=d.get("egrav", 0.0))
-        fields = {"rho": d["rho"], "c": d["c"]} if observable.needs_fields else None
-        row = constants.write(it, sim.state, sim.box, e, fields)
-        timer.step("observables")
-        maybe_dump(it)  # dumps recompute the full derived set (r, p, u, ...)
-        if insitu is not None:
-            insitu.execute(sim.state, sim.box, it)
-        timer.step("output")
-        if args.profile:
-            profile.record(it, timer.pop(), dt=float(d["dt"]),
-                           nc_mean=float(d["nc_mean"]))
-        extra_cols = " ".join(
-            f"{n}={v:.4g}" for n, v in zip(observable.extra_columns, row[7:])
-        )
-        log(
-            f"it {it:5d}  t={float(sim.state.ttot):.6g} dt={d['dt']:.4g} "
-            f"etot={float(e['etot']):.6f} ecin={float(e['ecin']):.4g} "
-            f"eint={float(e['eint']):.4g} nc~{d['nc_mean']:.0f}"
-            + (f" {extra_cols}" if extra_cols else "")
-        )
-        if num_steps is not None and it >= num_steps:
-            break
-        if target_time is not None and float(sim.state.ttot) >= target_time:
-            break
-        if args.duration is not None and time.time() - t0 >= args.duration:
-            # graceful wall-clock cutoff with a final restartable dump
-            # (sphexa.cpp:153-173 --duration semantics)
-            log(f"# wall-clock limit {args.duration}s reached at iteration {it}")
-            if dump_path is not None and last_dump_iteration[0] != it:
-                dump_now(it)
-            break
+    nan = float("nan")
+    if args.trace_dir:
+        # whole-run profiler capture: the TraceAnnotation scopes the
+        # Simulation emits (sphexa:launch/flush/reconfigure/rebuild-lists)
+        # name the spans inside this trace
+        import jax as _jax
+
+        os.makedirs(args.trace_dir, exist_ok=True)
+        _jax.profiler.start_trace(args.trace_dir)
+        telemetry.event("trace", dir=args.trace_dir)
+    try:
+        while True:
+            timer.start()
+            d = sim.step()
+            timer.step("step")
+            it = sim.iteration
+            if args.debug_checks and d.get("check_error"):
+                print(f"# debug-checks it {it}: {d['check_error']}",
+                      file=sys.stderr)
+            e = conserved_quantities(sim.state, const, egrav=d.get("egrav", 0.0))
+            fields = {"rho": d["rho"], "c": d["c"]} if observable.needs_fields else None
+            row = constants.write(it, sim.state, sim.box, e, fields)
+            timer.step("observables")
+            maybe_dump(it)  # dumps recompute the full derived set (r, p, u, ...)
+            if insitu is not None:
+                insitu.execute(sim.state, sim.box, it)
+            timer.step("output")
+            laps = timer.pop()
+            telemetry.event(
+                "phases", it=it, **{k: round(v, 6) for k, v in laps.items()}
+            )
+            if args.profile:
+                profile.record(it, laps, dt=float(d.get("dt", nan)),
+                               nc_mean=float(d.get("nc_mean", nan)))
+            extra_cols = " ".join(
+                f"{n}={v:.4g}" for n, v in zip(observable.extra_columns, row[7:])
+            )
+            log(
+                f"it {it:5d}  t={float(sim.state.ttot):.6g} dt={d['dt']:.4g} "
+                f"etot={float(e['etot']):.6f} ecin={float(e['ecin']):.4g} "
+                f"eint={float(e['eint']):.4g} nc~{d['nc_mean']:.0f}"
+                + (f" {extra_cols}" if extra_cols else "")
+            )
+            if num_steps is not None and it >= num_steps:
+                break
+            if target_time is not None and float(sim.state.ttot) >= target_time:
+                break
+            if args.duration is not None and time.time() - t0 >= args.duration:
+                # graceful wall-clock cutoff with a final restartable dump
+                # (sphexa.cpp:153-173 --duration semantics)
+                log(f"# wall-clock limit {args.duration}s reached at iteration {it}")
+                if dump_path is not None and last_dump_iteration[0] != it:
+                    dump_now(it)
+                break
+    finally:
+        if args.trace_dir:
+            _jax.profiler.stop_trace()
+            log(f"# profiler trace -> {args.trace_dir}")
     dt_wall = time.time() - t0
     n_done = sim.iteration - it0
     if args.profile:
@@ -492,21 +545,28 @@ def main(argv=None) -> int:
         # per-substep breakdown (the reference's per-phase Timer print,
         # util/timer.hpp): an equivalent SPLIT execution of the final
         # state, timed stage by stage (the fused production step has no
-        # internal walls — its fusion is the design)
+        # internal walls — its fusion is the design); skipped when there
+        # is no series to attach it to
         from sphexa_tpu.util.substep_profile import substep_breakdown
 
-        sub = substep_breakdown(sim)
+        sub = substep_breakdown(sim, telemetry=telemetry) if profile.rows \
+            else {}
         if sub:
             log("# substeps (s, split-execution upper bound): "
                 + " ".join(f"{k}={v:.4f}" for k, v in sub.items()))
-        profile.save(profile_path, substeps=sub)
-        means = profile.summary()
-        log("# profile (mean s/iter): "
-            + " ".join(f"{k}={v:.4f}" for k, v in means.items()
-                       if k in ("step", "observables", "output")))
-        log(f"# timing series -> {profile_path}")
+        if profile.save(profile_path, substeps=sub):
+            means = profile.summary()
+            log("# profile (mean s/iter): "
+                + " ".join(f"{k}={v:.4f}" for k, v in means.items()
+                           if k in ("step", "observables", "output")))
+            log(f"# timing series -> {profile_path}")
+        else:
+            print("# --profile: no iterations recorded, profile.npz not "
+                  "written", file=sys.stderr)
     if insitu is not None:
         log(f"# insitu: {insitu.finalize()} frames -> {args.out_dir}")
+    telemetry.event("run_end", iterations=n_done, wall_s=round(dt_wall, 3))
+    telemetry.close()
     log(f"# {n_done} iterations in {dt_wall:.2f}s "
         f"({state.n * n_done / dt_wall / 1e6:.3f}M particle-updates/s)")
     return 0

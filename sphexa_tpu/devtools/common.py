@@ -35,6 +35,7 @@ __all__ = [
     "baseline_key",
     "render_text",
     "render_json",
+    "render_table",
 ]
 
 
@@ -200,6 +201,30 @@ class Baseline:
 # ---------------------------------------------------------------------------
 # reporters
 # ---------------------------------------------------------------------------
+
+
+def render_table(rows: List[Tuple], headers: Optional[Tuple] = None) -> str:
+    """Column-aligned plain-text table (cells str()-ed, left-justified).
+
+    Lives here, next to the devtools reporters, as the one table
+    renderer in-repo CLIs share; current consumer is the
+    ``sphexa-telemetry`` summary/diff output.
+    """
+    srows = [tuple(str(c) for c in r) for r in rows]
+    if headers is not None:
+        srows = [tuple(str(c) for c in headers)] + srows
+    if not srows:
+        return ""
+    ncol = max(len(r) for r in srows)
+    srows = [r + ("",) * (ncol - len(r)) for r in srows]
+    widths = [max(len(r[i]) for r in srows) for i in range(ncol)]
+    lines = [
+        "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        for r in srows
+    ]
+    if headers is not None:
+        lines.insert(1, "  ".join("-" * w for w in widths).rstrip())
+    return "\n".join(lines)
 
 
 def render_text(new: List[Finding], grandfathered: List[Finding],

@@ -176,4 +176,11 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
         out = jitted(s, b, gtree, aux)
         return out if step_fn in aux_props else out[:3]
 
+    # expose the underlying jit cache so the Simulation's compile
+    # watchdog (telemetry retrace events) can probe sharded launches too;
+    # optional like the consumer's getattr probe — a jax without the
+    # private _cache_size just loses the watchdog, not the mesh path
+    cache_size = getattr(jitted, "_cache_size", None)
+    if cache_size is not None:
+        stepper._cache_size = cache_size
     return stepper

@@ -46,6 +46,15 @@ _SHARD_MAP_PARAMS = frozenset(
     _inspect.signature(_jax_shard_map).parameters
 )
 
+#: Canonical scalar diagnostics every propagator's step emits — the
+#: naming contract between the step functions, the Simulation driver's
+#: overflow checks, and the telemetry layer (sphexa_tpu/telemetry/).
+#: ``_integrate_and_finish`` is the single producer; propagator-specific
+#: extras (egrav, dt_cool, list_slack, ...) ride alongside but consumers
+#: must ``.get()`` them — only THESE keys may be assumed present.
+STEP_DIAG_KEYS = ("dt", "nc_mean", "nc_max", "occupancy", "rho_max",
+                  "h_max")
+
 
 def shard_map(*args, **kwargs):
     """Version-compat shard_map: the replication check kwarg was renamed
@@ -274,7 +283,9 @@ def _integrate_and_finish(
     """Shared step tail: drift/kick + PBC wrap, smoothing-length nudge,
     state rebuild, diagnostics. Every propagator's force stage funnels
     through here (the analog of the common trailing sequence of
-    std_hydro.hpp/ve_hydro.hpp step())."""
+    std_hydro.hpp/ve_hydro.hpp step()); the diagnostics dict it builds
+    carries exactly the STEP_DIAG_KEYS scalars plus whatever extras the
+    caller rides along."""
     fields = (state.x, state.y, state.z, state.x_m1, state.y_m1, state.z_m1,
               state.vx, state.vy, state.vz, state.h, state.temp,
               state.temp_lo, du, state.du_m1)

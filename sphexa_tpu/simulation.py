@@ -8,11 +8,14 @@ when particle motion invalidates it — the rare recompile boundary — and
 """
 
 import dataclasses
+import time
 from typing import Callable, Dict, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from sphexa_tpu.telemetry import Telemetry
 
 from sphexa_tpu.gravity.traversal import GravityConfig, estimate_gravity_caps
 from sphexa_tpu.gravity.tree import build_gravity_tree
@@ -257,7 +260,18 @@ class Simulation:
         m2p_cap_margin: float = 1.3,
         donate: object = "auto",
         debug_checks: bool = False,
+        telemetry: Optional[Telemetry] = None,
     ):
+        # telemetry registry: every driver-visible control-flow event
+        # (reconfigure/rollback/replay/retrace) and step timing reports
+        # here. A sink-less default keeps counters for free; pass a
+        # Telemetry with sinks (app --telemetry-dir) to persist them.
+        # Hot-loop contract: the instrumentation below is host-only —
+        # perf_counter stamps, Counter bumps, jit-cache-size reads — and
+        # must NEVER add a device->host transfer to the deferred happy
+        # path (pinned by tests/test_telemetry.py's no-sync guard).
+        self.telemetry = telemetry if telemetry is not None else Telemetry()
+        self._window_t0 = None  # host stamp of the open window's 1st launch
         self.state = state
         self.box = box
         self.const = const
@@ -426,7 +440,7 @@ class Simulation:
         self._last_diag: Dict[str, float] = {"reconfigured": 0.0}
         self._cfg: Optional[PropagatorConfig] = None
         self._gtree = None
-        self._configure()
+        self._configure(reason="initial")
 
     # -- static config management ------------------------------------------
     @property
@@ -438,7 +452,19 @@ class Simulation:
             and self.prop_name != "nbody"
         )
 
-    def _configure(self, min_cap: int = 0, grav_margin: float = 1.5):
+    def _configure(self, min_cap: int = 0, grav_margin: float = 1.5,
+                   reason: str = "reconfigure"):
+        with self.telemetry.annotate("sphexa:reconfigure"):
+            self._configure_impl(min_cap, grav_margin)
+        # a reconfigure used to be visible only as one dict entry
+        # (``reconfigured``) on one step's diagnostics — as telemetry it
+        # is a first-class event with the WHY attached; the expected
+        # construction-time sizing stays out of the health counter
+        if reason != "initial":
+            self.telemetry.count("reconfigures")
+        self.telemetry.event("reconfigure", it=self.iteration, reason=reason)
+
+    def _configure_impl(self, min_cap: int = 0, grav_margin: float = 1.5):
         self._lists = None  # any static re-size invalidates the lists
         if self._mesh is not None:
             # drain in-flight steps before dispatching the sizing jits:
@@ -653,6 +679,7 @@ class Simulation:
 
         from sphexa_tpu.propagator import rebuild_pair_lists
 
+        self.telemetry.event("rebuild_lists", it=self.iteration)
         for _ in range(3):
             if not self._use_lists:
                 # a reconfigure flipped the grid into fold mode or left
@@ -660,16 +687,18 @@ class Simulation:
                 # (self._lists stays None; steps run with lists=None)
                 return
             aux = self.chem if self.prop_name == "std-cooling" else None
-            state, box, lists, aux = rebuild_pair_lists(
-                self.state, self.box, self._cfg, aux
-            )
-            if not int(_jax.device_get(lists.overflow)):
+            with self.telemetry.annotate("sphexa:rebuild-lists"):
+                state, box, lists, aux = rebuild_pair_lists(
+                    self.state, self.box, self._cfg, aux
+                )
+                overflow = int(_jax.device_get(lists.overflow))
+            if not overflow:
                 self.state, self.box, self._lists = state, box, lists
                 if aux is not None:
                     self.chem = aux
                 return
             self._slot_margin *= 1.5
-            self._configure()
+            self._configure(reason="list-slot")
         raise RuntimeError("pair-list slot cap failed to converge")
 
     # -- main loop ----------------------------------------------------------
@@ -732,7 +761,49 @@ class Simulation:
         new_state, new_box, diagnostics = out
         return new_state, new_box, diagnostics, None, None
 
+    def _compiled_cache_size(self) -> int:
+        """Total jit-cache entries behind the ACTIVE launch path — the
+        compile-watchdog's probe (the runtime analog of jaxaudit JXA102's
+        cache-size-delta check, tests/test_audit.py). Pure host-side
+        metadata: safe on the sync-free deferred happy path."""
+        if self.debug_checks:
+            fns = [self._checked_cache.get("fn")]
+        elif self._mesh is not None:
+            fns = [getattr(self, "_stepper", None)]
+        else:
+            fns = [_PROPAGATORS[self.prop_name],
+                   _PROPAGATORS_DONATED[self.prop_name]]
+        total = 0
+        for f in fns:
+            size = getattr(f, "_cache_size", None)
+            if size is not None:
+                total += size()
+        return total
+
     def _launch(self, donate_ok: bool = False):
+        """Instrumented dispatch: the compile watchdog samples the active
+        jit cache around the launch — any growth means THIS launch traced
+        (first compile or a silent retrace) and is recorded as a
+        first-class ``retrace`` event instead of vanishing into an
+        unexplained slow step."""
+        c0 = self._compiled_cache_size()
+        # debug_checks rebuilds the checkified jit INSIDE the launch on a
+        # config change (new object, cache size resets to 1) — identity
+        # drift is a from-scratch compile the size delta alone would miss
+        fn0 = id(self._checked_cache.get("fn")) if self.debug_checks \
+            else None
+        with self.telemetry.annotate("sphexa:launch"):
+            out = self._launch_impl(donate_ok)
+        delta = self._compiled_cache_size() - c0
+        if (self.debug_checks and delta <= 0
+                and id(self._checked_cache.get("fn")) != fn0):
+            delta = 1
+        if delta > 0:
+            self.telemetry.count("retraces", delta)
+            self.telemetry.event("retrace", it=self.iteration, delta=delta)
+        return out
+
+    def _launch_impl(self, donate_ok: bool = False):
         """Dispatch one jitted step on the current state (no host sync
         beyond the CPU-mesh drain). Returns (new_state, new_box,
         diagnostics, new_turb, new_chem).
@@ -849,7 +920,7 @@ class Simulation:
         nbr_over = occ > self._cfg.nbr.cap
         self._configure(
             min_cap=0 if window_blown or not nbr_over else occ,
-            grav_margin=grav_margin,
+            grav_margin=grav_margin, reason="overflow",
         )
 
     def _step_checked(self) -> Dict[str, float]:
@@ -859,6 +930,7 @@ class Simulation:
         never corrupt state."""
         reconfigured = False
         grav_margin = 1.5
+        t0 = time.perf_counter()
         for _attempt in range(4):
             out = self._launch()
             diagnostics = {**out[2], **self._fetch_scalars(out[2])}
@@ -876,12 +948,16 @@ class Simulation:
             raise RuntimeError(
                 "neighbor/gravity caps failed to converge in 4 attempts"
             )
+        # launch -> batched scalar fetch is the step's device span (the
+        # fetch drains the dispatched program); retries charge here too,
+        # exactly like a recompile charges the reference's Timer
+        wall = time.perf_counter() - t0
         self._apply(out)
         self.iteration += 1
         if not self._config_still_valid(diagnostics):
             # config check FIRST: _configure() drops self._lists, so a
             # proactive rebuild before it would be wasted work
-            self._configure()
+            self._configure(reason="stale-grid")
             reconfigured = True
         else:
             self._maybe_rebuild_lists(diagnostics)
@@ -890,6 +966,12 @@ class Simulation:
             for k, v in diagnostics.items()
         }
         result["reconfigured"] = float(reconfigured)
+        self.telemetry.timing("step", wall)
+        self.telemetry.event(
+            "step", it=self.iteration, wall_s=round(wall, 6),
+            dt=float(result["dt"]) if "dt" in result else None,
+            reconfigured=bool(reconfigured),
+        )
         if self.debug_checks:
             # first triggered checkify predicate of THIS step ("" = all
             # NaN/Inf/OOB checks passed); .get() syncs, which is the
@@ -916,6 +998,10 @@ class Simulation:
         if self.check_every <= 1:
             return self._step_checked()
         if not self._pending:
+            # host stamp of the window's first launch: flush() attributes
+            # the whole window's device time against it — the only
+            # per-step timing the sync-free happy path can honestly give
+            self._window_t0 = time.perf_counter()
             # only the WINDOW-START state is pinned for rollback (one
             # extra state, not check_every of them — 68 MB/state at 100^3).
             # With donation active the window's first launch CONSUMES
@@ -929,6 +1015,9 @@ class Simulation:
         out = self._launch(donate_ok=True)
         self._apply(out)
         self.iteration += 1
+        # happy-path telemetry is launch-count only: diagnostics stay on
+        # device, timestamps are host-side — zero added transfers
+        self.telemetry.event("launch", it=self.iteration)
         self._pending.append(out[2])
         if len(self._pending) >= self.check_every:
             return self.flush()
@@ -943,12 +1032,24 @@ class Simulation:
             return self._last_diag
         pending, self._pending = self._pending, []
         prior, self._window_prior = self._window_prior, None
-        fetched = jax.device_get([self._scalar_view(d) for d in pending])
+        t0, self._window_t0 = self._window_t0, None
+        with self.telemetry.annotate("sphexa:flush"):
+            fetched = jax.device_get([self._scalar_view(d) for d in pending])
+        # the batched fetch drains every launched program, so this host
+        # span IS the window's device time; per-step attribution is its
+        # mean (what "step time" means under deferral, docs/OBSERVABILITY)
+        window_wall = time.perf_counter() - t0 if t0 is not None else 0.0
         bad = next(
             (i for i, scal in enumerate(fetched) if self._overflowed(scal)),
             None,
         )
         if bad is None:
+            self.telemetry.timing("step", window_wall)
+            self.telemetry.event(
+                "window", it=self.iteration, steps=len(pending),
+                wall_s=round(window_wall, 6),
+                per_step_s=round(window_wall / len(pending), 6),
+            )
             diagnostics = {**pending[-1], **fetched[-1]}
             result = {
                 k: np.asarray(v) if getattr(v, "ndim", 0) else float(v)
@@ -957,18 +1058,27 @@ class Simulation:
             result["reconfigured"] = 0.0
             self._last_diag = result
             if not self._config_still_valid(fetched[-1]):
-                self._configure()
+                self._configure(reason="stale-grid")
                 self._last_diag["reconfigured"] = 1.0
             else:
                 self._maybe_rebuild_lists(fetched[-1])
             return self._last_diag
         # roll back to the window start and replay every window step
         diag_bad = fetched[bad]
+        expiry_only = (
+            not self._lists_fresh(diag_bad)
+            and int(diag_bad["occupancy"]) <= self._cfg.nbr.cap
+            and not self._gravity_overflowed(diag_bad)
+        )
+        self.telemetry.count("rollbacks")
+        self.telemetry.event(
+            "rollback", it=self.iteration, to_it=prior[4],
+            steps=len(pending), bad_index=bad,
+            reason="list-expiry" if expiry_only else "overflow",
+        )
         (self.state, self.box, self.turb_state, self.chem,
          self.iteration) = prior
-        if (not self._lists_fresh(diag_bad)
-                and int(diag_bad["occupancy"]) <= self._cfg.nbr.cap
-                and not self._gravity_overflowed(diag_bad)):
+        if expiry_only:
             # expiry only: fresh lists on the rolled-back state suffice
             self._rebuild_lists()
         else:
@@ -977,21 +1087,29 @@ class Simulation:
             self._reconfigure_after_overflow(diag_bad, grav_margin)
         for _ in range(len(pending)):
             result = self._step_checked()
+        self.telemetry.event("replay", it=self.iteration, steps=len(pending))
         result["reconfigured"] = 1.0
         self._last_diag = result
         return result
 
     def run(self, num_steps: int, log_every: int = 0, printer=print):
+        # per-iteration report routes through the telemetry console sink
+        # when one is attached (``printer`` stays the fallback); scalar
+        # keys are propagator-dependent beyond STEP_DIAG_KEYS, so missing
+        # ones render as nan instead of KeyError-ing the whole run
+        emit = self.telemetry.console_printer(printer)
+        nan = float("nan")
         for _ in range(num_steps):
             d = self.step()
             if log_every and self.iteration % log_every == 0:
                 if d.get("deferred"):
-                    printer(f"it {self.iteration:5d}  (deferred check)")
+                    emit(f"it {self.iteration:5d}  (deferred check)")
                 else:
-                    printer(
+                    emit(
                         f"it {self.iteration:5d}  t={float(self.state.ttot):.6g}  "
-                        f"dt={d['dt']:.4g}  nc~{d['nc_mean']:.1f}  "
-                        f"rho_max={d['rho_max']:.4g}"
+                        f"dt={float(d.get('dt', nan)):.4g}  "
+                        f"nc~{float(d.get('nc_mean', nan)):.1f}  "
+                        f"rho_max={float(d.get('rho_max', nan)):.4g}"
                     )
         # the final partial window must be verified before the state is
         # handed back — overflow must never corrupt state

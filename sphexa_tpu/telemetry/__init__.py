@@ -1,0 +1,56 @@
+"""Unified telemetry: structured step metrics, zero-sync device timing,
+profiler trace hooks, and persisted run artifacts.
+
+The reference prints a per-phase Timer every iteration and dumps the
+series with --profile (main/src/util/timer.hpp, ipropagator.hpp:80-119).
+Here the same role is played by ONE registry (`Telemetry`) with pluggable
+sinks:
+
+- ``JsonlSink``  — append-only ``events.jsonl`` per run (the persisted,
+  diffable record a regression gate can consume);
+- ``MemorySink`` — in-memory event list for tests;
+- ``ConsoleSink``— human-readable notable-event lines.
+
+Design constraint (the reason this is not just a logger): on deferred
+check windows (``Simulation(check_every > 1)``) the happy path is
+sync-free by design — telemetry may only timestamp launches host-side
+and count events; device time is attributed per WINDOW at ``flush()``,
+whose batched diagnostics fetch is the block boundary that already
+exists. Nothing in this package ever adds a device->host transfer to
+the hot loop (pinned by tests/test_telemetry.py's no-sync guard).
+
+``sphexa-telemetry`` (telemetry/cli.py) summarizes a run directory
+(p50/p95 step time, retrace/rollback counts, phase means) and diffs two
+runs — or a run against a ``BENCH_r*.json`` round — with threshold-based
+exit codes. See docs/OBSERVABILITY.md for the event schema.
+"""
+
+from sphexa_tpu.telemetry.manifest import (
+    MANIFEST_SCHEMA,
+    build_manifest,
+    read_manifest,
+    write_manifest,
+)
+from sphexa_tpu.telemetry.registry import (
+    EVENT_KINDS,
+    SCHEMA_VERSION,
+    LapTimer,
+    StepSeries,
+    Telemetry,
+)
+from sphexa_tpu.telemetry.sinks import ConsoleSink, JsonlSink, MemorySink
+
+__all__ = [
+    "Telemetry",
+    "LapTimer",
+    "StepSeries",
+    "JsonlSink",
+    "MemorySink",
+    "ConsoleSink",
+    "SCHEMA_VERSION",
+    "EVENT_KINDS",
+    "MANIFEST_SCHEMA",
+    "build_manifest",
+    "write_manifest",
+    "read_manifest",
+]

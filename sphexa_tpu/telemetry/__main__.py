@@ -1,0 +1,8 @@
+"""``python -m sphexa_tpu.telemetry`` — the sphexa-telemetry CLI."""
+
+import sys
+
+from sphexa_tpu.telemetry.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,361 @@
+"""``sphexa-telemetry``: summarize a telemetry run or diff two of them.
+
+    sphexa-telemetry summary <run-dir> [--format text|json] [--strict]
+    sphexa-telemetry diff <baseline> <candidate> [--threshold F]
+
+``summary`` reads ``<run-dir>/manifest.json`` + ``events.jsonl`` and
+reports p50/p95/mean step time, retrace/rollback/reconfigure counts and
+per-phase means. ``--strict`` exits 1 on any schema-invalid event (the
+check.sh --telemetry-only gate).
+
+``diff`` compares two run directories, two bench JSONs (``bench.py``
+output or the ``BENCH_r*.json`` driver wrapper), or a run against a
+bench baseline (throughput derived as particles / p50 step time). Exit
+codes are CI-shaped: 0 within threshold, 1 regression beyond it, 2
+usage/unreadable input — so a pipeline can gate on step-time
+regressions directly.
+
+Deliberately jax-free: summarizing a run must not drag in a backend.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sphexa_tpu.devtools.common import render_table
+from sphexa_tpu.telemetry.manifest import read_manifest
+from sphexa_tpu.telemetry.registry import validate_event
+
+
+class TelemetryError(Exception):
+    """Unreadable/invalid input (CLI exit code 2)."""
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def load_events(run_dir: str) -> Tuple[List[dict], List[str]]:
+    """(events, problems) from ``<run_dir>/events.jsonl``. Unparseable
+    lines and schema violations are collected, not fatal — a killed run
+    leaves a readable prefix and the summary should still work."""
+    path = os.path.join(run_dir, "events.jsonl")
+    if not os.path.exists(path):
+        raise TelemetryError(f"no events.jsonl in {run_dir}")
+    events: List[dict] = []
+    problems: List[str] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except json.JSONDecodeError as exc:
+                problems.append(f"line {lineno}: unparseable ({exc})")
+                continue
+            bad = validate_event(e)
+            if bad:
+                problems.append(f"line {lineno}: " + "; ".join(bad))
+            events.append(e)
+    return events, problems
+
+
+def _of_kind(events: List[dict], kind: str) -> List[dict]:
+    return [e for e in events if e.get("kind") == kind]
+
+
+def summarize_run(run_dir: str) -> Dict:
+    """Aggregate one run directory into the summary dict.
+
+    "Step time" unifies both checking modes: synchronous steps contribute
+    their own wall time (``step`` events); deferred windows contribute
+    their per-step mean once per window step (``window`` events) — the
+    only honest per-step number when the happy path never syncs
+    (docs/OBSERVABILITY.md, deferred-window semantics).
+    """
+    events, problems = load_events(run_dir)
+    # schema-invalid events are reported as problems, never fatal — a
+    # killed run's truncated line must not take the summary down with it
+    samples: List[float] = []
+    for e in _of_kind(events, "step"):
+        if isinstance(e.get("wall_s"), (int, float)):
+            samples.append(float(e["wall_s"]))
+    for e in _of_kind(events, "window"):
+        if isinstance(e.get("per_step_s"), (int, float)) \
+                and isinstance(e.get("steps"), int):
+            samples.extend([float(e["per_step_s"])] * e["steps"])
+
+    phases: Dict[str, List[float]] = {}
+    for e in _of_kind(events, "phases"):
+        for k, v in e.items():
+            if k in ("v", "seq", "t", "kind", "it"):
+                continue
+            if isinstance(v, (int, float)):
+                phases.setdefault(k, []).append(float(v))
+
+    step_time = {}
+    if samples:
+        arr = np.asarray(samples)
+        step_time = {
+            "count": len(samples),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p95_s": float(np.percentile(arr, 95)),
+            "mean_s": float(arr.mean()),
+            "max_s": float(arr.max()),
+        }
+    return {
+        "run_dir": run_dir,
+        "manifest": read_manifest(run_dir),
+        "events": len(events),
+        "steps": len(samples),
+        "windows": len(_of_kind(events, "window")),
+        "launches": len(_of_kind(events, "launch")),
+        "step_time": step_time,
+        "retraces": int(sum(e.get("delta", 1)
+                            for e in _of_kind(events, "retrace"))),
+        "rollbacks": len(_of_kind(events, "rollback")),
+        "replayed_steps": int(sum(e.get("steps", 0)
+                                  for e in _of_kind(events, "replay"))),
+        # construction-time sizing is expected once per run, not a
+        # mid-run health signal — only non-initial rebuilds count
+        "reconfigures": len([e for e in _of_kind(events, "reconfigure")
+                             if e.get("reason") != "initial"]),
+        "phase_mean_s": {k: float(np.mean(v)) for k, v in sorted(
+            phases.items())},
+        "schema_problems": problems,
+    }
+
+
+def _parse_bench_json(path: str) -> Dict:
+    """bench.py's JSON line, or the driver's BENCH_r*.json wrapper whose
+    ``tail`` buries that line in captured output."""
+    with open(path) as f:
+        data = json.load(f)
+    if "metric" in data and "value" in data:
+        return data
+    if "tail" in data:
+        for line in reversed(str(data["tail"]).splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    inner = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "metric" in inner and "value" in inner:
+                    return inner
+    raise TelemetryError(f"{path}: not a bench JSON (no metric/value line)")
+
+
+def load_side(path: str) -> Dict:
+    """One diff operand: a telemetry run dir or a bench JSON file."""
+    if os.path.isdir(path):
+        s = summarize_run(path)
+        return {"type": "run", "label": path, "summary": s}
+    if os.path.isfile(path):
+        b = _parse_bench_json(path)
+        return {"type": "bench", "label": path, "bench": b}
+    raise TelemetryError(f"{path}: neither a run directory nor a file")
+
+
+# ---------------------------------------------------------------------------
+# diff
+# ---------------------------------------------------------------------------
+
+
+def _run_updates_per_sec(side: Dict) -> Optional[float]:
+    s = side["summary"]
+    manifest = s.get("manifest") or {}
+    n = manifest.get("particles")
+    p50 = s.get("step_time", {}).get("p50_s")
+    if not n or not p50:
+        return None
+    return float(n) / float(p50)
+
+
+def diff_sides(base: Dict, cand: Dict, threshold: float) -> Dict:
+    """Compare candidate against baseline. Returns the comparison dict;
+    ``regressed`` is True when the headline metric moved past the
+    threshold in the bad direction (step time up / throughput down)."""
+    rows: List[Dict] = []
+
+    def row(metric, a, b, higher_is_better, headline=False):
+        if a is None or b is None:
+            return
+        if a == 0:
+            change = 0.0 if b == 0 else float("inf")
+        else:
+            change = b / a - 1.0
+        bad = (change < -threshold) if higher_is_better \
+            else (change > threshold)
+        rows.append({
+            "metric": metric, "baseline": a, "candidate": b,
+            "change": change, "headline": headline,
+            "regressed": bool(headline and bad),
+        })
+
+    if base["type"] == "run" and cand["type"] == "run":
+        a, b = base["summary"], cand["summary"]
+        at, bt = a.get("step_time", {}), b.get("step_time", {})
+        row("step_time_p50_s", at.get("p50_s"), bt.get("p50_s"),
+            higher_is_better=False, headline=True)
+        row("step_time_p95_s", at.get("p95_s"), bt.get("p95_s"),
+            higher_is_better=False)
+        row("retraces", a["retraces"], b["retraces"],
+            higher_is_better=False)
+        row("rollbacks", a["rollbacks"], b["rollbacks"],
+            higher_is_better=False)
+        row("reconfigures", a["reconfigures"], b["reconfigures"],
+            higher_is_better=False)
+        for k in sorted(set(a["phase_mean_s"]) & set(b["phase_mean_s"])):
+            row(f"phase_{k}_mean_s", a["phase_mean_s"][k],
+                b["phase_mean_s"][k], higher_is_better=False)
+    elif base["type"] == "bench" and cand["type"] == "bench":
+        a, b = base["bench"], cand["bench"]
+        row("updates_per_sec", a.get("value"), b.get("value"),
+            higher_is_better=True, headline=True)
+        ea, eb = a.get("extra", {}) or {}, b.get("extra", {}) or {}
+        for k in sorted(set(ea) & set(eb)):
+            if isinstance(ea[k], (int, float)) and isinstance(
+                    eb[k], (int, float)):
+                row(k, ea[k], eb[k],
+                    higher_is_better="updates_per_sec" in k)
+    else:
+        # mixed: throughput is the one commensurable axis
+        def ups(side):
+            if side["type"] == "bench":
+                return side["bench"].get("value")
+            return _run_updates_per_sec(side)
+
+        a, b = ups(base), ups(cand)
+        if a is None or b is None:
+            raise TelemetryError(
+                "run-vs-bench diff needs 'particles' in the run manifest "
+                "and a step-time p50 (re-run with --telemetry-dir)"
+            )
+        row("updates_per_sec", a, b, higher_is_better=True, headline=True)
+
+    if not rows:
+        raise TelemetryError("nothing comparable between the two inputs")
+    return {
+        "baseline": base["label"],
+        "candidate": cand["label"],
+        "threshold": threshold,
+        "rows": rows,
+        "regressed": any(r["regressed"] for r in rows),
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v * 1e3:.3f} ms"
+
+
+def render_summary(s: Dict) -> str:
+    m = s.get("manifest") or {}
+    lines = [f"run: {s['run_dir']}"]
+    if m:
+        lines.append(
+            f"  git {m.get('git_rev', '?')}  jax {m.get('jax_version', '?')}"
+            f"  backend {m.get('backend', '?')}"
+            f"  devices {m.get('device_count', '?')}"
+            + (f"  mesh {m['mesh_shape']}" if m.get("mesh_shape") else "")
+            + (f"  N={m['particles']}" if m.get("particles") else "")
+        )
+    else:
+        lines.append("  (no manifest.json)")
+    st = s.get("step_time") or {}
+    rows = [
+        ("steps", s["steps"]),
+        ("deferred windows", s["windows"]),
+        ("step time p50", _fmt_s(st.get("p50_s"))),
+        ("step time p95", _fmt_s(st.get("p95_s"))),
+        ("step time mean", _fmt_s(st.get("mean_s"))),
+        ("retraces", s["retraces"]),
+        ("rollbacks", s["rollbacks"]),
+        ("replayed steps", s["replayed_steps"]),
+        ("reconfigures", s["reconfigures"]),
+    ]
+    for k, v in s["phase_mean_s"].items():
+        rows.append((f"phase {k} (mean)", _fmt_s(v)))
+    lines.append(render_table(rows))
+    for p in s["schema_problems"]:
+        lines.append(f"  schema: {p}")
+    return "\n".join(lines)
+
+
+def render_diff(d: Dict) -> str:
+    lines = [f"baseline:  {d['baseline']}",
+             f"candidate: {d['candidate']}",
+             f"threshold: {d['threshold'] * 100:.1f}%"]
+    rows = []
+    for r in d["rows"]:
+        mark = "REGRESSED" if r["regressed"] else (
+            "*" if r["headline"] else "")
+        rows.append((r["metric"], f"{r['baseline']:.6g}",
+                     f"{r['candidate']:.6g}",
+                     f"{r['change'] * 100:+.1f}%", mark))
+    lines.append(render_table(
+        rows, headers=("metric", "baseline", "candidate", "change", "")))
+    lines.append("regression detected" if d["regressed"]
+                 else "within threshold")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sphexa-telemetry",
+        description="summarize / diff sphexa-tpu telemetry runs",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("summary", help="summarize one run directory")
+    ps.add_argument("run_dir")
+    ps.add_argument("--format", choices=("text", "json"), default="text")
+    ps.add_argument("--strict", action="store_true",
+                    help="exit 1 on any schema-invalid event")
+    pd = sub.add_parser("diff", help="diff candidate against baseline")
+    pd.add_argument("baseline", help="run dir or bench JSON")
+    pd.add_argument("candidate", help="run dir or bench JSON")
+    pd.add_argument("--threshold", type=float, default=0.10,
+                    help="relative headline-regression threshold [0.10]")
+    pd.add_argument("--format", choices=("text", "json"), default="text")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.cmd == "summary":
+            s = summarize_run(args.run_dir)
+            print(json.dumps(s, indent=2) if args.format == "json"
+                  else render_summary(s))
+            return 1 if (args.strict and s["schema_problems"]) else 0
+        d = diff_sides(load_side(args.baseline), load_side(args.candidate),
+                       args.threshold)
+        print(json.dumps(d, indent=2) if args.format == "json"
+              else render_diff(d))
+        return 1 if d["regressed"] else 0
+    except TelemetryError as e:
+        print(f"sphexa-telemetry: {e}", file=sys.stderr)
+        return 2
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"sphexa-telemetry: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""The metrics registry: counters, gauges, phase timings, typed events.
+
+One ``Telemetry`` instance is shared by everything that measures a run —
+the Simulation driver, the app loop's ``Timer`` laps, bench.py — so every
+surface reports into the same place instead of three disconnected ones
+(the pre-telemetry state: util/timer.py wall laps, a one-shot
+substep_breakdown, and the per-step diagnostics dict).
+
+Host-side only, by construction: nothing here touches device arrays.
+Callers hand in already-host scalars (floats, ints); the zero-sync
+deferred-window contract lives in the CALLERS (Simulation.step/flush)
+and is pinned by tests/test_telemetry.py.
+"""
+
+import contextlib
+import time
+from collections import Counter, defaultdict
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+#: events.jsonl schema version; bump on any incompatible field change and
+#: document the migration in docs/OBSERVABILITY.md.
+SCHEMA_VERSION = 1
+
+#: every event kind the schema admits, with its required payload fields
+#: (beyond the envelope ``v``/``seq``/``t``/``kind``). The CLI's --strict
+#: validation enforces exactly this table.
+EVENT_KINDS: Dict[str, tuple] = {
+    "launch": ("it",),            # one deferred-window step dispatched
+    "step": ("it", "wall_s"),     # one synchronously checked step done
+    "window": ("it", "steps", "wall_s", "per_step_s"),  # deferred flush
+    "reconfigure": ("it", "reason"),
+    "rollback": ("it", "steps", "reason"),
+    "replay": ("it", "steps"),
+    "retrace": ("it", "delta"),   # jit cache grew on a launch (recompile)
+    "rebuild_lists": ("it",),
+    "phases": ("it",),            # per-iteration host phase laps
+    "trace": ("dir",),            # jax.profiler trace started
+    "run_end": (),
+    "note": (),
+}
+
+
+def _jsonable(v):
+    """Coerce numpy scalars so sinks can json.dumps payloads directly."""
+    if isinstance(v, (np.floating, np.integer)):
+        return v.item()
+    return v
+
+
+def validate_event(e: dict) -> List[str]:
+    """Schema-v1 problems with one event dict ([] = valid)."""
+    problems = []
+    if not isinstance(e, dict):
+        return ["event is not an object"]
+    if e.get("v") != SCHEMA_VERSION:
+        problems.append(f"bad schema version {e.get('v')!r}")
+    kind = e.get("kind")
+    if kind not in EVENT_KINDS:
+        problems.append(f"unknown kind {kind!r}")
+    else:
+        for field in EVENT_KINDS[kind]:
+            if field not in e:
+                problems.append(f"{kind} event missing field {field!r}")
+    for field in ("seq", "t"):
+        if not isinstance(e.get(field), (int, float)):
+            problems.append(f"missing/non-numeric envelope field {field!r}")
+    return problems
+
+
+class Telemetry:
+    """Counters + gauges + phase timings + an event stream over sinks.
+
+    With no sinks the registry still accumulates (bench.py uses that to
+    report retrace/rollback counts without writing files); ``event()``
+    then costs one Counter bump — cheap enough for the hot loop.
+    """
+
+    def __init__(self, sinks=()):
+        self.sinks = list(sinks)
+        self.counters: Counter = Counter()
+        self.gauges: Dict[str, float] = {}
+        self.phase_totals: Dict[str, float] = defaultdict(float)
+        self.phase_counts: Counter = Counter()
+        self._seq = 0
+
+    # -- scalar metrics ----------------------------------------------------
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    def timing(self, name: str, seconds: float) -> None:
+        """Accumulate one lap of a named phase (mean via timing_mean)."""
+        self.phase_totals[name] += float(seconds)
+        self.phase_counts[name] += 1
+
+    def timing_mean(self, name: str) -> float:
+        n = self.phase_counts[name]
+        return self.phase_totals[name] / n if n else float("nan")
+
+    # -- event stream ------------------------------------------------------
+    def event(self, kind: str, **payload) -> None:
+        """Emit one typed event to every sink (and count it regardless)."""
+        self.counters[f"events.{kind}"] += 1
+        if not self.sinks:
+            return
+        e = {
+            "v": SCHEMA_VERSION,
+            "seq": self._seq,
+            "t": round(time.time(), 6),
+            "kind": kind,
+            **{k: _jsonable(v) for k, v in payload.items()},
+        }
+        self._seq += 1
+        for s in self.sinks:
+            s.emit(e)
+
+    def phases(self, it: int, laps: Dict[str, float]) -> None:
+        """Per-iteration host phase laps (the Timer's pop) as one event;
+        each lap also feeds the registry's phase accumulators."""
+        for k, v in laps.items():
+            self.timing(k, v)
+        self.event("phases",
+                   it=int(it), **{k: round(float(v), 6)
+                                  for k, v in laps.items()})
+
+    # -- profiler hooks ----------------------------------------------------
+    def annotate(self, name: str):
+        """Named scope for jax.profiler traces (TraceAnnotation): shows up
+        in a --trace-dir capture around launch/flush/reconfigure/rebuild.
+        Falls back to a no-op context when jax is unavailable (the CLI
+        never imports jax)."""
+        global _TRACE_ANNOTATION
+        if _TRACE_ANNOTATION is None:
+            try:
+                from jax.profiler import TraceAnnotation
+                _TRACE_ANNOTATION = TraceAnnotation
+            except Exception:
+                _TRACE_ANNOTATION = False
+        if not _TRACE_ANNOTATION:
+            return contextlib.nullcontext()
+        return _TRACE_ANNOTATION(name)
+
+    # -- console routing ---------------------------------------------------
+    def console_printer(self, fallback: Callable = print) -> Callable:
+        """The first console sink's line writer, else ``fallback`` —
+        Simulation.run routes its per-iteration report through this."""
+        for s in self.sinks:
+            w = getattr(s, "write_line", None)
+            if w is not None:
+                return w
+        return fallback
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()
+
+
+_TRACE_ANNOTATION = None  # resolved lazily by Telemetry.annotate
+
+
+# ---------------------------------------------------------------------------
+# lap timing + per-iteration series (the util/timer.py implementations,
+# now living on the registry so every consumer shares one accumulation)
+# ---------------------------------------------------------------------------
+
+
+class LapTimer:
+    """Accumulates named wall-clock laps within one iteration
+    (timer.hpp:46 semantics); each lap also feeds ``telemetry.timing``."""
+
+    def __init__(self, telemetry: Optional[Telemetry] = None):
+        self.telemetry = telemetry
+        self.laps: Dict[str, float] = {}
+        self._t = time.perf_counter()
+
+    def start(self) -> None:
+        self._t = time.perf_counter()
+
+    def lap(self, name: str) -> float:
+        """Record time since the last mark under ``name``."""
+        now = time.perf_counter()
+        elapsed = now - self._t
+        self.laps[name] = self.laps.get(name, 0.0) + elapsed
+        self._t = now
+        if self.telemetry is not None:
+            self.telemetry.timing(name, elapsed)
+        return elapsed
+
+    # reference-parity alias (util/timer.hpp's Timer::step)
+    step = lap
+
+    def pop(self) -> Dict[str, float]:
+        out = self.laps
+        self.laps = {}
+        return out
+
+
+class StepSeries:
+    """Per-iteration timing/metric rows, saved as an npz series
+    (ipropagator.hpp:83-87 writes the analogous HDF5 series). With a
+    telemetry registry attached, every row is also emitted as a
+    ``phases`` event."""
+
+    def __init__(self, telemetry: Optional[Telemetry] = None):
+        self.telemetry = telemetry
+        self.rows: List[Dict[str, float]] = []
+
+    def record(self, iteration: int, laps: Dict[str, float], **metrics):
+        self.rows.append({"iteration": float(iteration), **laps, **metrics})
+        if self.telemetry is not None:
+            self.telemetry.phases(iteration, {**laps, **metrics})
+
+    def save(self, path: str, substeps=None) -> bool:
+        """Write the series (+ optional one-shot substep breakdown as
+        substep_<name> scalars). Returns whether a file was written —
+        with zero rows and no substeps nothing is, and the caller must
+        not report a series that doesn't exist (app/main.py --profile)."""
+        if not self.rows and not substeps:
+            return False
+        keys = sorted({k for row in self.rows for k in row})
+        # ragged rows (a metric recorded only on some iterations) are
+        # NaN-padded so every column is one dense array
+        arrays = {
+            k: np.array([row.get(k, np.nan) for row in self.rows])
+            for k in keys
+        }
+        for k, v in (substeps or {}).items():
+            arrays[f"substep_{k}"] = np.float64(v)
+        np.savez(path, **arrays)
+        return True
+
+    def summary(self) -> Dict[str, float]:
+        """Mean seconds per iteration for each recorded phase."""
+        if not self.rows:
+            return {}
+        keys = {k for row in self.rows for k in row} - {"iteration"}
+        return {
+            k: float(np.nanmean([row.get(k, np.nan) for row in self.rows]))
+            for k in sorted(keys)
+        }

@@ -9,10 +9,15 @@ EQUIVALENT split execution of the current state — each pipeline stage as
 its own jit — at profiling granularity (once per run, not per step).
 Numbers are indicative: the fused step overlaps/fuses across these
 boundaries, so the split SUM is an upper bound on the fused step time.
+
+Thin adapter over the telemetry registry: pass ``telemetry=`` and every
+stage time is recorded as a ``substep_<stage>`` timing plus one
+``phases`` event, so a telemetry-enabled run persists the breakdown in
+events.jsonl alongside the npz the app writes.
 """
 
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 
@@ -27,11 +32,20 @@ def _t(fn, *args, iters=3):
     return out, (time.perf_counter() - t0) / iters
 
 
-def substep_breakdown(sim, iters: int = 3) -> Dict[str, float]:
+def substep_breakdown(sim, iters: int = 3,
+                      telemetry: Optional[object] = None) -> Dict[str, float]:
     """Per-stage wall times (seconds) of one force pass on the CURRENT
     simulation state. Supports the engine ('pallas') std and ve
     pipelines; other configurations return {} (the coarse per-iteration
     laps in the --profile series still cover them)."""
+    out = _substep_breakdown(sim, iters)
+    if telemetry is not None and out:
+        telemetry.phases(sim.iteration,
+                         {f"substep_{k}": v for k, v in out.items()})
+    return out
+
+
+def _substep_breakdown(sim, iters: int = 3) -> Dict[str, float]:
     from sphexa_tpu.propagator import _sort_by_keys
     from sphexa_tpu.sfc.box import make_global_box
     from sphexa_tpu.sph import hydro_std, hydro_ve

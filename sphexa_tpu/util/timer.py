@@ -1,4 +1,5 @@
-"""Wall-clock phase timing + profile series.
+"""Wall-clock phase timing + profile series — thin adapters over the
+telemetry registry (sphexa_tpu/telemetry/registry.py).
 
 Counterpart of the reference's ``main/src/util/timer.hpp`` (per-substep
 Timer printed each iteration, dumpable as a timing series with --profile,
@@ -6,67 +7,23 @@ ipropagator.hpp:80-119). The TPU step is one fused XLA program, so the
 measurable phases are coarser: step (device compute incl. any recompile),
 observables, output. The profile dump is an npz timeseries instead of the
 reference's HDF5 group.
+
+The implementations live on the registry (LapTimer / StepSeries) so that
+laps recorded here ALSO accumulate in a shared ``Telemetry`` instance
+when one is passed — the app loop, Simulation driver and bench then all
+report into the same place. These names stay for API stability.
 """
 
-import time
-from typing import Dict, List
-
-import numpy as np
+from sphexa_tpu.telemetry.registry import LapTimer, StepSeries
 
 
-class Timer:
-    """Accumulates named wall-clock laps within one iteration."""
-
-    def __init__(self):
-        self.laps: Dict[str, float] = {}
-        self._t = time.perf_counter()
-
-    def start(self):
-        self._t = time.perf_counter()
-
-    def step(self, name: str) -> float:
-        """Record time since the last mark under ``name`` (timer.hpp:46)."""
-        now = time.perf_counter()
-        elapsed = now - self._t
-        self.laps[name] = self.laps.get(name, 0.0) + elapsed
-        self._t = now
-        return elapsed
-
-    def pop(self) -> Dict[str, float]:
-        out = self.laps
-        self.laps = {}
-        return out
+class Timer(LapTimer):
+    """Accumulates named wall-clock laps within one iteration
+    (``step(name)`` records since the last mark, timer.hpp:46); pass
+    ``telemetry=`` to mirror every lap into a registry."""
 
 
-class ProfileRecorder:
+class ProfileRecorder(StepSeries):
     """Per-iteration timing/metric rows; saved with --profile
-    (ipropagator.hpp:83-87 writes the analogous HDF5 series)."""
-
-    def __init__(self):
-        self.rows: List[Dict[str, float]] = []
-
-    def record(self, iteration: int, laps: Dict[str, float], **metrics):
-        self.rows.append({"iteration": float(iteration), **laps, **metrics})
-
-    def save(self, path: str, substeps=None):
-        """Write the per-iteration series (+ optional one-shot substep
-        breakdown, stored as substep_<name> scalars)."""
-        if not self.rows and not substeps:
-            return
-        keys = sorted({k for row in self.rows for k in row})
-        arrays = {
-            k: np.array([row.get(k, np.nan) for row in self.rows]) for k in keys
-        }
-        for k, v in (substeps or {}).items():
-            arrays[f"substep_{k}"] = np.float64(v)
-        np.savez(path, **arrays)
-
-    def summary(self) -> Dict[str, float]:
-        """Mean seconds per iteration for each recorded phase."""
-        if not self.rows:
-            return {}
-        keys = {k for row in self.rows for k in row} - {"iteration"}
-        return {
-            k: float(np.nanmean([row.get(k, np.nan) for row in self.rows]))
-            for k in sorted(keys)
-        }
+    (ipropagator.hpp:83-87 writes the analogous HDF5 series).
+    ``save`` returns whether a file was actually written."""

@@ -1,0 +1,357 @@
+"""Telemetry subsystem: registry/sink semantics, the zero-sync
+deferred-window guard (the runtime JXA104 analog: no device->host
+transfer may ride the happy path), rollback/retrace/replay events as
+first-class telemetry, and the sphexa-telemetry CLI contracts
+(summary schema validation, diff thresholds + exit codes)."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.propagator import STEP_DIAG_KEYS
+from sphexa_tpu.simulation import Simulation
+from sphexa_tpu.telemetry import (
+    ConsoleSink,
+    JsonlSink,
+    MemorySink,
+    SCHEMA_VERSION,
+    Telemetry,
+    write_manifest,
+)
+from sphexa_tpu.telemetry.cli import main as cli_main
+from sphexa_tpu.telemetry.registry import validate_event
+
+
+# ---------------------------------------------------------------------------
+# registry + sinks
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counters_gauges_timings(self):
+        t = Telemetry()
+        t.count("x")
+        t.count("x", 2)
+        t.gauge("g", 1.5)
+        t.timing("p", 0.5)
+        t.timing("p", 1.5)
+        assert t.counters["x"] == 3
+        assert t.gauges["g"] == 1.5
+        assert t.timing_mean("p") == 1.0
+        assert np.isnan(t.timing_mean("missing"))
+
+    def test_event_envelope_and_seq(self):
+        sink = MemorySink()
+        t = Telemetry(sinks=[sink])
+        t.event("note", msg="a")
+        t.event("note", msg="b")
+        a, b = sink.events
+        assert a["v"] == SCHEMA_VERSION and a["kind"] == "note"
+        assert (a["seq"], b["seq"]) == (0, 1)
+        assert a["msg"] == "a"
+        # counted even without reading the sink
+        assert t.counters["events.note"] == 2
+
+    def test_sinkless_event_is_counter_only(self):
+        t = Telemetry()
+        t.event("step", it=1, wall_s=0.1)  # must not raise, must count
+        assert t.counters["events.step"] == 1
+        assert t._seq == 0  # no envelope built
+
+    def test_numpy_payloads_json_safe(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        t = Telemetry(sinks=[JsonlSink(path)])
+        t.event("note", a=np.float32(1.5), b=np.int64(3))
+        t.close()
+        (e,) = [json.loads(l) for l in open(path)]
+        assert e["a"] == 1.5 and e["b"] == 3
+
+    def test_validate_event(self):
+        ok = {"v": SCHEMA_VERSION, "seq": 0, "t": 1.0, "kind": "step",
+              "it": 1, "wall_s": 0.1}
+        assert validate_event(ok) == []
+        assert validate_event({**ok, "v": 99})
+        assert validate_event({**ok, "kind": "bogus"})
+        bad = dict(ok)
+        del bad["wall_s"]
+        assert any("wall_s" in p for p in validate_event(bad))
+
+    def test_console_sink_and_printer_routing(self):
+        lines = []
+        sink = ConsoleSink(printer=lines.append)
+        t = Telemetry(sinks=[sink])
+        t.event("rollback", it=4, steps=3, reason="overflow")
+        t.event("launch", it=1)  # not notable: no console line
+        assert len(lines) == 1 and "rollback" in lines[0]
+        t.console_printer()("raw line")
+        assert lines[-1] == "raw line"  # routed through the sink
+        assert Telemetry().console_printer(print) is print
+
+    def test_jsonl_round_trip(self, tmp_path):
+        from sphexa_tpu.telemetry.cli import load_events
+
+        run = tmp_path / "run"
+        t = Telemetry(sinks=[JsonlSink(str(run / "events.jsonl"))])
+        t.event("step", it=1, wall_s=0.25, dt=0.1, reconfigured=False)
+        t.event("retrace", it=1, delta=2)
+        t.close()
+        events, problems = load_events(str(run))
+        assert problems == []
+        assert [e["kind"] for e in events] == ["step", "retrace"]
+        assert events[0]["wall_s"] == 0.25 and events[1]["delta"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Simulation wiring
+# ---------------------------------------------------------------------------
+
+
+def _sedov_sim(side=8, telemetry=None, **kw):
+    state, box, const = init_sedov(side)
+    return Simulation(state, box, const, prop="std", block=4096,
+                      telemetry=telemetry, **kw)
+
+
+class TestSimulationTelemetry:
+    def test_step_diag_contract(self):
+        sim = _sedov_sim()
+        d = sim.step()
+        assert set(STEP_DIAG_KEYS) <= set(d)
+
+    def test_sync_steps_emit_step_events(self):
+        sink = MemorySink()
+        sim = _sedov_sim(telemetry=Telemetry(sinks=[sink]))
+        sim.step()
+        sim.step()
+        steps = sink.of_kind("step")
+        assert [e["it"] for e in steps] == [1, 2]
+        assert all(e["wall_s"] > 0 and e["dt"] > 0 for e in steps)
+        recfg = sink.of_kind("reconfigure")
+        assert recfg and recfg[0]["reason"] == "initial"
+
+    def test_deferred_happy_path_is_sync_free(self, tmp_path, monkeypatch):
+        """The JXA104-analog runtime guard: with telemetry fully enabled
+        (JSONL sink + registry), deferred-window steps must not issue ANY
+        device->host transfer — jax.device_get / block_until_ready are
+        poisoned for the whole happy-path window and only restored for
+        the flush, which is where the one batched fetch belongs."""
+        sink = JsonlSink(str(tmp_path / "events.jsonl"))
+        tel = Telemetry(sinks=[sink])
+        sim = _sedov_sim(side=12, telemetry=tel, check_every=4)
+        # settle compiles + config on a first full window
+        for _ in range(4):
+            sim.step()
+
+        real_get = jax.device_get
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "device->host transfer on the deferred happy path"
+            )
+
+        monkeypatch.setattr(jax, "device_get", boom)
+        monkeypatch.setattr(jax, "block_until_ready", boom)
+        for _ in range(3):
+            d = sim.step()
+            assert d.get("deferred") == 1.0
+        monkeypatch.setattr(jax, "device_get", real_get)
+        monkeypatch.undo()
+        d = sim.flush()
+        assert "deferred" not in d or d.get("deferred") != 1.0
+        tel.close()
+
+        events = [json.loads(l) for l in open(tmp_path / "events.jsonl")]
+        kinds = [e["kind"] for e in events]
+        # 7 launches (both windows), 2 window flushes, no rollbacks
+        assert kinds.count("launch") == 7
+        windows = [e for e in events if e["kind"] == "window"]
+        assert len(windows) == 2
+        assert windows[-1]["steps"] == 3
+        assert windows[-1]["per_step_s"] > 0
+        assert "rollback" not in kinds
+
+    def test_rollback_retrace_replay_events(self):
+        """A deferred-detected overflow must surface as first-class
+        rollback/replay telemetry (it used to be visible only as
+        ``reconfigured`` on one diagnostics dict), and the forced
+        reconfigure's fresh compile must trip the retrace watchdog."""
+        state, box, const = init_sedov(12)
+        sink = MemorySink()
+        sim = Simulation(state, box, const, prop="std", block=4096,
+                         check_every=3, telemetry=Telemetry(sinks=[sink]))
+        sim._cfg = dataclasses.replace(
+            sim._cfg, nbr=dataclasses.replace(sim._cfg.nbr, cap=8)
+        )
+        for _ in range(3):
+            sim.step()
+        d = sim.flush() if sim._pending else sim._last_diag
+        assert d["reconfigured"] == 1.0
+        (rb,) = sink.of_kind("rollback")
+        assert rb["reason"] == "overflow"
+        assert rb["steps"] == 3 and rb["to_it"] == 0 and rb["bad_index"] == 0
+        (rp,) = sink.of_kind("replay")
+        assert rp["steps"] == 3
+        # the replayed window runs through the checked path: 3 step events
+        assert len(sink.of_kind("step")) == 3
+        assert any(e["reason"] == "overflow"
+                   for e in sink.of_kind("reconfigure"))
+        assert sim.telemetry.counters["rollbacks"] == 1
+        assert sim.telemetry.counters["retraces"] >= 1
+        assert sink.of_kind("retrace")
+
+    def test_run_line_survives_missing_diag_keys(self):
+        """Simulation.run's report uses .get() + nan for propagator-
+        specific scalars and routes through the console sink."""
+        lines = []
+        sim = _sedov_sim(
+            telemetry=Telemetry(sinks=[ConsoleSink(printer=lines.append)])
+        )
+        sim.step = lambda: {"reconfigured": 0.0}  # diagnostics-poor step
+        sim.run(1, log_every=1, printer=None)  # printer unused: sink wins
+        (line,) = [l for l in lines if l.startswith("it ")]
+        assert "nan" in line and "rho_max=nan" in line
+
+    def test_run_printer_fallback_without_sink(self):
+        lines = []
+        sim = _sedov_sim(side=8)
+        sim.run(1, log_every=1, printer=lines.append)
+        assert len(lines) == 1 and "rho_max=" in lines[0]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _make_run(tmp_path, name, step_walls, particles=1000):
+    d = tmp_path / name
+    t = Telemetry(sinks=[JsonlSink(str(d / "events.jsonl"))])
+    for i, w in enumerate(step_walls, 1):
+        t.event("step", it=i, wall_s=w, dt=0.1, reconfigured=False)
+    t.event("retrace", it=1, delta=1)
+    t.close()
+    write_manifest(str(d), particles=particles, config={"side": 8})
+    return str(d)
+
+
+class TestCli:
+    def test_summary_text_and_json(self, tmp_path, capsys):
+        run = _make_run(tmp_path, "a", [0.1, 0.2, 0.3])
+        assert cli_main(["summary", run]) == 0
+        out = capsys.readouterr().out
+        assert "step time p50" in out and "retraces" in out
+        assert cli_main(["summary", run, "--format", "json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["steps"] == 3 and s["retraces"] == 1
+        assert s["step_time"]["p50_s"] == pytest.approx(0.2)
+        assert s["manifest"]["particles"] == 1000
+
+    def test_summary_strict_flags_schema_drift(self, tmp_path, capsys):
+        run = _make_run(tmp_path, "a", [0.1])
+        with open(f"{run}/events.jsonl", "a") as f:
+            f.write('{"v":1,"seq":9,"t":1.0,"kind":"bogus"}\n')
+            f.write("not json\n")
+            # truncated step/window events (killed run): flagged but must
+            # not crash the aggregation
+            f.write('{"v":1,"seq":10,"t":1.0,"kind":"step","it":2}\n')
+            f.write('{"v":1,"seq":11,"t":1.0,"kind":"window","it":3,'
+                    '"steps":2}\n')
+        assert cli_main(["summary", run]) == 0  # lax by default
+        out = capsys.readouterr().out
+        assert "steps" in out
+        assert cli_main(["summary", run, "--strict"]) == 1
+        assert "schema:" in capsys.readouterr().out
+
+    def test_jsonl_sink_truncates_per_run(self, tmp_path):
+        """One sink = one run: re-running into the same --telemetry-dir
+        must not merge two runs' events under one manifest."""
+        from sphexa_tpu.telemetry.cli import load_events
+
+        path = str(tmp_path / "events.jsonl")
+        for it in (1, 2):
+            t = Telemetry(sinks=[JsonlSink(path)])
+            t.event("step", it=it, wall_s=0.1)
+            t.close()
+        events, problems = load_events(str(tmp_path))
+        assert problems == []
+        assert len(events) == 1 and events[0]["it"] == 2
+
+    def test_summary_excludes_initial_configure(self, tmp_path):
+        from sphexa_tpu.telemetry.cli import summarize_run
+
+        sim = _sedov_sim(
+            telemetry=Telemetry(
+                sinks=[JsonlSink(str(tmp_path / "events.jsonl"))])
+        )
+        sim.step()
+        sim.telemetry.close()
+        s = summarize_run(str(tmp_path))
+        # the construction-time sizing is not a mid-run reconfigure
+        assert s["reconfigures"] == 0
+        assert sim.telemetry.counters.get("reconfigures", 0) == 0
+        assert sim.telemetry.counters["events.reconfigure"] == 1
+
+    def test_summary_missing_run_is_usage_error(self, tmp_path, capsys):
+        assert cli_main(["summary", str(tmp_path / "nope")]) == 2
+        assert "events.jsonl" in capsys.readouterr().err
+
+    def test_diff_runs_threshold_exit_codes(self, tmp_path, capsys):
+        base = _make_run(tmp_path, "base", [0.1] * 5)
+        cand = _make_run(tmp_path, "cand", [0.25] * 5)
+        # 150% slower: beyond a 50% threshold, within a 200% one
+        assert cli_main(["diff", base, cand, "--threshold", "0.5"]) == 1
+        assert "REGRESSED" in capsys.readouterr().out
+        assert cli_main(["diff", base, cand, "--threshold", "2.0"]) == 0
+        # faster candidate is never a step-time regression
+        assert cli_main(["diff", cand, base, "--threshold", "0.5"]) == 0
+
+    def test_diff_bench_files(self, tmp_path, capsys):
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(
+            {"metric": "m", "value": 100.0, "unit": "u",
+             "extra": {"ve_updates_per_sec": 70.0}}))
+        # driver wrapper shape (BENCH_r*.json): bench line buried in tail
+        b.write_text(json.dumps(
+            {"n": 5, "rc": 0,
+             "tail": "warn\n" + json.dumps(
+                 {"metric": "m", "value": 50.0, "unit": "u",
+                  "extra": {"ve_updates_per_sec": 90.0}})}))
+        assert cli_main(["diff", str(a), str(b)]) == 1  # throughput halved
+        capsys.readouterr()
+        assert cli_main(["diff", str(b), str(a)]) == 0
+        out = capsys.readouterr().out
+        assert "updates_per_sec" in out
+
+    def test_diff_run_vs_bench(self, tmp_path):
+        # run: 1000 particles / 0.1 s p50 = 1e4 ups vs bench 5e3 -> ok
+        run = _make_run(tmp_path, "run", [0.1] * 4, particles=1000)
+        bench = tmp_path / "bench.json"
+        bench.write_text(json.dumps({"metric": "m", "value": 5e3,
+                                     "unit": "u"}))
+        assert cli_main(["diff", str(bench), run]) == 0
+        # and a bench far above the run's throughput regresses
+        bench.write_text(json.dumps({"metric": "m", "value": 5e5,
+                                     "unit": "u"}))
+        assert cli_main(["diff", str(bench), run]) == 1
+
+    def test_app_writes_manifest_and_events(self, tmp_path):
+        from sphexa_tpu.app.main import main as app_main
+        from sphexa_tpu.telemetry.cli import summarize_run
+
+        tdir = str(tmp_path / "telemetry")
+        rc = app_main(["--init", "sedov", "-n", "6", "-s", "2", "--quiet",
+                       "-o", str(tmp_path / "out"), "--telemetry-dir", tdir])
+        assert rc == 0
+        s = summarize_run(tdir)
+        assert s["schema_problems"] == []
+        assert s["steps"] == 2
+        assert s["manifest"]["particles"] == 216
+        assert s["manifest"]["config"]["prop"] == "std"
+        assert s["phase_mean_s"]  # Timer laps flowed through as phases
+        assert cli_main(["summary", tdir, "--strict"]) == 0
