@@ -220,6 +220,10 @@ def main() -> int:
         "retraces": int(tel.counters.get("retraces", 0)),
         "rollbacks": int(tel.counters.get("rollbacks", 0)),
         "reconfigures": int(tel.counters.get("reconfigures", 0)),
+        # distributed health (schema v2): zero on single-chip benches,
+        # nonzero = the mesh run resized halos / tripped the watchdog
+        "halo_trips": int(tel.counters.get("halo_trips", 0)),
+        "imbalances": int(tel.counters.get("imbalances", 0)),
     }
 
     # measured breakdowns/commentary live in docs/NEXT.md, labeled with the

@@ -48,13 +48,72 @@ run_telemetry() {
         rm -rf "$dir"
         exit $rc
     fi
-    # --strict: every event must validate against the v1 schema
+    # --strict: every event must validate against the schema
     python -m sphexa_tpu.telemetry summary "$dir/run" --strict
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "sphexa-telemetry summary failed (rc=$rc); schema drift or"
+        echo "missing events — see docs/OBSERVABILITY.md."
+        exit $rc
+    fi
+
+    echo "== distributed telemetry smoke (2-device CPU mesh -> shards view) =="
+    # sparse halo exchange + schema-v2 shard events on a forced
+    # 2-virtual-device mesh: the CPU rehearsal of the v5e-16 campaign's
+    # day-one instrumentation (exchange/shard_load/memory events)
+    python -m sphexa_tpu.app.main \
+        --init sedov -n 8 -s 5 --quiet \
+        --devices 2 --cpu-mesh --backend pallas --check-every 5 \
+        --telemetry-dir "$dir/mesh" -o "$dir/mesh_out"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "2-device mesh smoke run failed (rc=$rc)"
+        rm -rf "$dir"
+        exit $rc
+    fi
+    # shards must RENDER per-shard telemetry (exit 1 = events missing)
+    python -m sphexa_tpu.telemetry shards "$dir/mesh"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "sphexa-telemetry shards failed (rc=$rc): the mesh run wrote"
+        echo "no per-shard telemetry — exchange/shard_load wiring broke."
+        exit $rc
+    fi
+    python -m sphexa_tpu.telemetry summary "$dir/mesh" --strict
     rc=$?
     rm -rf "$dir"
     if [ $rc -ne 0 ]; then
-        echo "sphexa-telemetry summary failed (rc=$rc); schema drift or"
-        echo "missing events — see docs/OBSERVABILITY.md."
+        echo "strict schema validation failed on the mesh run (rc=$rc)"
+        exit $rc
+    fi
+}
+
+run_multichip_diff() {
+    echo "== multi-chip comm-volume gate (measure_multichip --quick vs baseline) =="
+    local tmp rc
+    tmp=$(mktemp -d)
+    env JAX_PLATFORMS=cpu python scripts/measure_multichip.py \
+        --quick --json > "$tmp/multichip.json"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "measure_multichip --quick failed (rc=$rc)"
+        rm -rf "$tmp"
+        exit $rc
+    fi
+    # threshold exit codes over the MULTICHIP wrapper shape: headline is
+    # the sparse-exchange saving vs replication (higher = better); a
+    # candidate shipping >5% more rows than the committed baseline fails
+    python -m sphexa_tpu.telemetry diff MULTICHIP_BASELINE.json \
+        "$tmp/multichip.json" --threshold 0.05
+    rc=$?
+    rm -rf "$tmp"
+    if [ $rc -ne 0 ]; then
+        echo "multi-chip comm volume regressed vs MULTICHIP_BASELINE.json"
+        echo "(rc=$rc); if intentional, regenerate the baseline:"
+        echo "  scripts/measure_multichip.py --quick --json  (wrap in the"
+        echo "  {n_devices, rc, tail} driver shape, see the current file)"
         exit $rc
     fi
 }
@@ -77,6 +136,7 @@ esac
 run_lint
 run_audit
 run_telemetry
+run_multichip_diff
 
 echo "== tier-1 tests (fast tier, CPU) =="
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

@@ -3,9 +3,18 @@
 derive bytes/particle, and extrapolate to the 400^3 / 16-chip target
 (64M particles -> 4M/chip).
 
+Built on the shared HBM accounting surface (telemetry/memory.py): the
+same per-device ``memory_stats()`` snapshot the runtime ``memory``
+events stamp at manifest/post-compile/flush, so this script's numbers
+and a run's events.jsonl are the same quantity. ``--profile-dir`` also
+dumps a ``jax.profiler`` device-memory profile (pprof) per size — the
+allocation-site breakdown behind a surprising peak.
+
 Usage: [HBM_SIDES=100,126,159] python scripts/measure_hbm.py
+       [--devices N] [--profile-dir DIR]
 """
 
+import argparse
 import os
 import sys
 
@@ -15,30 +24,57 @@ import jax
 
 from sphexa_tpu.init import init_sedov
 from sphexa_tpu.simulation import Simulation
+from sphexa_tpu.telemetry.memory import (
+    device_memory_snapshot,
+    save_memory_profile,
+)
 
 SIDES = [int(s) for s in os.environ.get("HBM_SIDES", "100,126,159,200").split(",")]
 
 
-def peak_bytes():
-    st = jax.local_devices()[0].memory_stats() or {}
-    return st.get("peak_bytes_in_use", 0), st.get("bytes_in_use", 0)
-
-
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=None,
+                    help="shard over N devices (per-device peaks reported)")
+    ap.add_argument("--profile-dir", default=None, dest="profile_dir",
+                    help="write a device-memory profile (pprof) per size")
+    args = ap.parse_args(argv)
+    if args.profile_dir:
+        os.makedirs(args.profile_dir, exist_ok=True)
     for side in SIDES:
         n = side ** 3
         try:
             state, box, const = init_sedov(side)
+            if args.devices and n % args.devices:
+                keep = (n // args.devices) * args.devices
+                state = jax.tree.map(
+                    lambda a: a[:keep] if getattr(a, "ndim", 0) == 1 else a,
+                    state)
+                n = keep
             sim = Simulation(state, box, const, prop="ve", block=8192,
-                             check_every=5)
+                             check_every=5, num_devices=args.devices)
             for _ in range(5):
                 sim.step()
             sim.flush()
             jax.block_until_ready(sim.state.x)
-            peak, cur = peak_bytes()
-            print(f"side={side} n={n} peak={peak/2**30:.2f} GiB "
-                  f"({peak/n:.0f} B/particle) live={cur/2**30:.2f} GiB",
-                  flush=True)
+            snap = device_memory_snapshot()
+            peaks = snap["peak_bytes_in_use"]
+            lives = snap["bytes_in_use"]
+            if not peaks:
+                print(f"side={side} n={n} (backend reports no "
+                      f"memory_stats — CPU?)", flush=True)
+            else:
+                peak, cur = max(peaks), max(lives)
+                per_dev = "" if len(peaks) == 1 else (
+                    "  per-dev peaks: "
+                    + " ".join(f"{p/2**30:.2f}" for p in peaks))
+                print(f"side={side} n={n} peak={peak/2**30:.2f} GiB "
+                      f"({sum(peaks)/n:.0f} B/particle) "
+                      f"live={cur/2**30:.2f} GiB{per_dev}", flush=True)
+            if args.profile_dir:
+                path = os.path.join(args.profile_dir, f"hbm_s{side}.pprof")
+                if save_memory_profile(path):
+                    print(f"  memory profile -> {path}", flush=True)
             del sim, state
         except Exception as e:
             print(f"side={side} n={n} FAILED: {type(e).__name__}: {e}"[:160],
@@ -47,7 +83,8 @@ def main():
     # extrapolation guide printed for BASELINE.md
     print("target: 64M/16 chips = 4.0M particles/chip; v5e HBM = 16 GiB",
           flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -3,11 +3,25 @@ particles-per-shard grows, and bytes moved per exchange stage vs the
 round-2 full-array replication baseline.
 
 Size-based (no device timing): the windowed all_to_all moves
-(P-1) * Wmax rows per shard per stage; replication moved S * (P-1).
+(P-1) * Wmax rows per shard per stage; replication moved S * (P-1);
+the sparse per-cell exchange ships sum(hmax) — the same formulas the
+runtime ``exchange`` telemetry events stamp (docs/OBSERVABILITY.md,
+schema v2), so a run's events are checkable against this script.
 
 Usage: JAX_PLATFORMS=cpu python scripts/measure_multichip.py
+       [--quick] [--json]
+
+``--json`` prints one bench-schema line ({"metric","value","unit",
+"extra","manifest"}) — the shape ``sphexa-telemetry diff`` consumes
+directly or buried in a ``MULTICHIP_r*.json`` wrapper's tail, giving
+multi-chip comm regressions threshold exit codes in CI (the check.sh
+full gate diffs a --quick run against MULTICHIP_BASELINE.json).
+``--quick`` restricts to two small deterministic rows (no settling
+step) so the gate stays cheap.
 """
 
+import argparse
+import json
 import os
 import sys
 
@@ -26,9 +40,9 @@ from sphexa_tpu.sfc.keys import compute_sfc_keys
 from sphexa_tpu.simulation import Simulation, make_propagator_config
 
 
-def measure(side, P):
+def measure(side, P, settle=True):
     state, box, const = init_sedov(side)
-    if side < 120:
+    if settle and side < 120:
         # settle one step so the measured distribution is in-run, not the
         # raw lattice; at 4M+ a CPU step costs minutes and the lattice is
         # an adequate stand-in for the volume scaling
@@ -82,25 +96,80 @@ def measure(side, P):
                 shipped=sum(hcells), shipped_frac=sum(hcells) / S)
 
 
-def main():
-    print(f"{'side':>5} {'n':>9} {'P':>3} {'S':>8} {'Wmax':>7} "
-          f"{'Wmax/S':>7} {'rows/stage':>11} {'vs repl':>8} "
-          f"{'sparse':>8} {'sparse/S':>8} {'shipped':>8} {'ship/S':>7}")
-    for side, P in ((16, 8), (24, 8), (32, 8), (48, 8), (64, 8),
-                    (80, 8), (160, 8), (160, 16),
-                    (48, 2), (48, 4), (48, 16)):
+#: the cheap deterministic rows of --quick mode: lattice state (no
+#: settling step). side 16 = the dryrun scale sanity row; side 40 = the
+#: first size whose sparse caps are genuinely partial on the lattice
+#: (saving > 1 — the quantity the CI gate can actually see regress)
+QUICK_CASES = ((16, 8), (40, 8))
+
+FULL_CASES = ((16, 8), (24, 8), (32, 8), (48, 8), (64, 8),
+              (80, 8), (160, 8), (160, 16),
+              (48, 2), (48, 4), (48, 16))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="two small rows, no settling step (CI gate)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print one bench-schema JSON line for "
+                         "sphexa-telemetry diff")
+    args = ap.parse_args(argv)
+    cases = QUICK_CASES if args.quick else FULL_CASES
+    results = []
+    if not args.as_json:
+        print(f"{'side':>5} {'n':>9} {'P':>3} {'S':>8} {'Wmax':>7} "
+              f"{'Wmax/S':>7} {'rows/stage':>11} {'vs repl':>8} "
+              f"{'sparse':>8} {'sparse/S':>8} {'shipped':>8} {'ship/S':>7}")
+    for side, P in cases:
         try:
-            r = measure(side, P)
-            print(f"{side:>5} {r['n']:>9} {P:>3} {r['S']:>8} "
-                  f"{r['wmax']:>7} {r['ratio']:>7.3f} "
-                  f"{r['win_rows']:>11} {r['saving']:>7.2f}x "
-                  f"{r['sparse']:>8.0f} {r['sparse_frac']:>8.3f} "
-                  f"{r['shipped']:>8} {r['shipped_frac']:>7.2f}",
-                  flush=True)
+            r = measure(side, P, settle=not args.quick)
+            results.append((side, P, r))
+            if not args.as_json:
+                print(f"{side:>5} {r['n']:>9} {P:>3} {r['S']:>8} "
+                      f"{r['wmax']:>7} {r['ratio']:>7.3f} "
+                      f"{r['win_rows']:>11} {r['saving']:>7.2f}x "
+                      f"{r['sparse']:>8.0f} {r['sparse_frac']:>8.3f} "
+                      f"{r['shipped']:>8} {r['shipped_frac']:>7.2f}",
+                      flush=True)
         except Exception as e:
             print(f"{side:>5} P={P} FAILED: {type(e).__name__}: {e}"[:140],
-                  flush=True)
+                  file=sys.stderr, flush=True)
+    if not args.as_json:
+        return 0
+    if not results:
+        print("measure_multichip: every case failed", file=sys.stderr)
+        return 1
+    # headline: sparse-exchange saving vs full replication at the largest
+    # measured row (higher is better — same diff direction as throughput);
+    # per-row extras are flat numerics so `sphexa-telemetry diff` compares
+    # them with the bench-vs-bench machinery
+    side, P, head = results[-1]
+    extra = {}
+    for s, p, r in results:
+        tag = f"s{s}_p{p}"
+        extra[f"{tag}_shipped_rows"] = int(r["shipped"])
+        extra[f"{tag}_shipped_frac"] = round(r["shipped_frac"], 4)
+        extra[f"{tag}_sparse_frac"] = round(r["sparse_frac"], 4)
+        extra[f"{tag}_wmax_frac"] = round(r["ratio"], 4)
+        extra[f"{tag}_saving"] = round(r["rep_rows"] / max(r["shipped"], 1),
+                                       4)
+    from sphexa_tpu.telemetry.manifest import build_manifest
+
+    print(json.dumps({
+        "metric": f"sparse-halo saving vs replication "
+                  f"(sedov {side}^3, P={P})",
+        "value": round(head["rep_rows"] / max(head["shipped"], 1), 4),
+        "unit": "x",
+        "extra": extra,
+        "manifest": build_manifest(
+            config={"quick": bool(args.quick),
+                    "cases": [list(c) for c in cases]},
+            particles=head["n"],
+        ),
+    }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

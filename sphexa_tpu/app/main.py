@@ -87,6 +87,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="multi-chip halo exchange: sparse cell-granular "
                         "per-distance buffers (default) or contiguous "
                         "per-peer windows")
+    p.add_argument("--backend", default="auto",
+                   choices=("auto", "pallas", "xla"),
+                   help="force the engine backend (auto: pallas on TPU, "
+                        "xla elsewhere); pallas off-TPU runs the Mosaic "
+                        "kernels in interpret mode — the CPU-mesh "
+                        "rehearsal path the multi-chip dry run uses")
+    p.add_argument("--check-every", type=int, default=1,
+                   dest="check_every",
+                   help="deferred cap-checking window: launch N steps "
+                        "with no device sync, fetch/verify diagnostics "
+                        "in one batch at the window end (default 1 = "
+                        "synchronous)")
+    p.add_argument("--imbalance-ratio", type=float, default=1.5,
+                   dest="imbalance_ratio",
+                   help="imbalance-watchdog threshold on max/mean of the "
+                        "per-shard load/comm metrics (telemetry "
+                        "'imbalance' events) [1.5]")
+    p.add_argument("--memory-profile", default=None, dest="memory_profile",
+                   help="write a jax.profiler device-memory profile "
+                        "(pprof) to this path at the end of the run")
     p.add_argument("--insitu", default=None,
                    help="in-situ rendering per iteration: slice | projection "
                         "(the Ascent/Catalyst adaptor role, ascent_adaptor.h)")
@@ -285,12 +305,15 @@ def main(argv=None) -> int:
                          keep_fields=observable.needs_fields, theta=args.theta,
                          m2p_cap_margin=args.m2p_cap_margin,
                          num_devices=args.devices, halo_mode=args.halo_mode,
+                         backend=args.backend,
+                         check_every=args.check_every,
+                         imbalance_ratio=args.imbalance_ratio,
                          debug_checks=args.debug_checks, telemetry=telemetry)
     except (NotImplementedError, ValueError) as e:
         print(str(e), file=sys.stderr)
         return 2
     if args.telemetry_dir:
-        from sphexa_tpu.telemetry import write_manifest
+        from sphexa_tpu.telemetry import emit_memory_event, write_manifest
 
         mesh = getattr(sim, "_mesh", None)
         write_manifest(
@@ -301,6 +324,13 @@ def main(argv=None) -> int:
             mesh_shape=tuple(mesh.devices.shape) if mesh is not None
             else None,
             extra={"case": case_name or args.init, "prop": args.prop},
+        )
+        # manifest-point HBM snapshot: pre-compile residency (the state
+        # arrays + constants), the baseline the post-compile and flush
+        # snapshots are read against (docs/OBSERVABILITY.md)
+        emit_memory_event(
+            telemetry, "manifest",
+            devices=list(mesh.devices.flat) if mesh is not None else None,
         )
         log(f"# telemetry -> {args.telemetry_dir}")
     log(f"# sphexa-tpu --init {args.init} N={state.n} prop={args.prop}")
@@ -499,6 +529,29 @@ def main(argv=None) -> int:
             if args.debug_checks and d.get("check_error"):
                 print(f"# debug-checks it {it}: {d['check_error']}",
                       file=sys.stderr)
+            if d.get("deferred"):
+                # mid-window step (--check-every > 1): NO device->host
+                # sync may happen here — observables/constants would
+                # fetch state scalars and defeat the deferred window, so
+                # they run at check boundaries only (the flush emits the
+                # window's telemetry). -s (iterations) and --duration
+                # are pure host arithmetic and still apply; a -s TIME
+                # target needs state.ttot and so only fires at check
+                # boundaries
+                timer.pop()
+                log(f"it {it:5d}  (deferred check)")
+                if num_steps is not None and it >= num_steps:
+                    break
+                if args.duration is not None \
+                        and time.time() - t0 >= args.duration:
+                    log(f"# wall-clock limit {args.duration}s reached "
+                        f"at iteration {it}")
+                    if dump_path is not None \
+                            and last_dump_iteration[0] != it:
+                        sim.flush()  # verify before the final dump
+                        dump_now(it)
+                    break
+                continue
             e = conserved_quantities(sim.state, const, egrav=d.get("egrav", 0.0))
             fields = {"rho": d["rho"], "c": d["c"]} if observable.needs_fields else None
             row = constants.write(it, sim.state, sim.box, e, fields)
@@ -518,9 +571,11 @@ def main(argv=None) -> int:
                 f"{n}={v:.4g}" for n, v in zip(observable.extra_columns, row[7:])
             )
             log(
-                f"it {it:5d}  t={float(sim.state.ttot):.6g} dt={d['dt']:.4g} "
+                f"it {it:5d}  t={float(sim.state.ttot):.6g} "
+                f"dt={float(d.get('dt', nan)):.4g} "
                 f"etot={float(e['etot']):.6f} ecin={float(e['ecin']):.4g} "
-                f"eint={float(e['eint']):.4g} nc~{d['nc_mean']:.0f}"
+                f"eint={float(e['eint']):.4g} "
+                f"nc~{float(d.get('nc_mean', nan)):.0f}"
                 + (f" {extra_cols}" if extra_cols else "")
             )
             if num_steps is not None and it >= num_steps:
@@ -538,6 +593,11 @@ def main(argv=None) -> int:
         if args.trace_dir:
             _jax.profiler.stop_trace()
             log(f"# profiler trace -> {args.trace_dir}")
+    # drain any open deferred window (--check-every > 1, -s not a
+    # multiple): the state must be verified before the final report and
+    # the telemetry window/flush events must land (Simulation.run's
+    # trailing flush, mirrored)
+    sim.flush()
     dt_wall = time.time() - t0
     n_done = sim.iteration - it0
     if args.profile:
@@ -565,6 +625,14 @@ def main(argv=None) -> int:
                   "written", file=sys.stderr)
     if insitu is not None:
         log(f"# insitu: {insitu.finalize()} frames -> {args.out_dir}")
+    if args.memory_profile:
+        from sphexa_tpu.telemetry import save_memory_profile
+
+        if save_memory_profile(args.memory_profile):
+            log(f"# device-memory profile -> {args.memory_profile}")
+        else:
+            print("# --memory-profile: profiler unavailable, no dump "
+                  "written", file=sys.stderr)
     telemetry.event("run_end", iterations=n_done, wall_s=round(dt_wall, 3))
     telemetry.close()
     log(f"# {n_done} iterations in {dt_wall:.2f}s "

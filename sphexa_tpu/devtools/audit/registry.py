@@ -208,7 +208,12 @@ def halo_exchange_sparse():
     hmax = (S_shard,) * (P - 1)  # full per-distance coverage at tiny N
 
     def stage(b, keys, x, y, z, h, m):
-        ranges, serve, jbuf, escaped = ex.shard_halo_stage_sparse(
+        # the 5-tuple contract: the per-shard telemetry dict rides the
+        # audited trace too, so JXA104/JXA106 cover the schema-v2 metric
+        # plumbing (all_gathered exchange scalars) alongside the exchange
+        from sphexa_tpu.propagator import _shard_metrics
+
+        ranges, serve, jbuf, escaped, hmetrics = ex.shard_halo_stage_sparse(
             x, y, z, h, keys, b, nbr, P, hmax, "p"
         )
         halo = serve((x, y, z, m))
@@ -216,13 +221,16 @@ def halo_exchange_sparse():
         esc = jax.lax.pmax(
             jnp.asarray(escaped, jnp.int32), "p"
         )
-        return jx, jy, jz, jm, esc
+        smetrics = _shard_metrics(ranges, escaped, hmetrics, "p")
+        return jx, jy, jz, jm, esc, smetrics
 
     Pp, Pr = PartitionSpec("p"), PartitionSpec()
+    from sphexa_tpu.propagator import SHARD_DIAG_KEYS
+
     fn = jax.jit(shard_map(
         stage, mesh=mesh,
         in_specs=(Pr, Pp, Pp, Pp, Pp, Pp, Pp),
-        out_specs=(Pp, Pp, Pp, Pp, Pr),
+        out_specs=(Pp, Pp, Pp, Pp, Pr, {k: Pr for k in SHARD_DIAG_KEYS}),
         check_vma=False,
     ))
     return EntryCase(fn=fn, args=(box, skeys, x, y, z, h, m))

@@ -208,7 +208,13 @@ def shard_halo_stage(x, y, z, h, keys, box, nbr, P: int, Wmax: int,
     """Shared prologue of a sharded pair-op stage: global table ->
     group windows on the local slab -> localized runs + serve/jbuf
     closures. One implementation for every sharded force stage so the
-    overflow contract cannot diverge between pipelines."""
+    overflow contract cannot diverge between pipelines.
+
+    The 5th element is the per-shard telemetry dict (see
+    ``exchange_metrics_windowed``) — cheap in-graph scalars the driver
+    fetches at its existing flush boundary (schema-v2 ``exchange``
+    events); computing them here keeps the measured quantities
+    definitionally identical to what the exchange actually ships."""
     from sphexa_tpu.sph.pallas_pairs import group_cell_ranges
 
     S = x.shape[0]
@@ -223,7 +229,23 @@ def shard_halo_stage(x, y, z, h, keys, box, nbr, P: int, Wmax: int,
     def jbuf(own, halo):
         return tuple(jnp.concatenate([o, a]) for o, a in zip(own, halo))
 
-    return ranges, serve, jbuf, escaped
+    metrics = exchange_metrics_windowed(bounds, Wmax, P, k)
+    return ranges, serve, jbuf, escaped, metrics
+
+
+def exchange_metrics_windowed(bounds_all, Wmax: int, P: int, k):
+    """Per-shard comm telemetry of the windowed exchange, from the
+    already-negotiated (P_dest, P_src, 2) bounds matrix: ``halo_rows`` =
+    this shard's true need (sum of its per-source window spans — the
+    windowed path SHIPS (P-1) * Wmax regardless), ``halo_occ`` = the
+    fullest window's span / Wmax (1.0 = the static window is exactly
+    consumed; drift past it trips the escape sentinel)."""
+    mine = bounds_all[k]  # (P_src, 2) — own row is [INF32, 0]
+    span = jnp.maximum(mine[:, 1] - jnp.minimum(mine[:, 0], mine[:, 1]), 0)
+    rows = jnp.sum(span).astype(jnp.int32)
+    occ = (jnp.max(span).astype(jnp.float32)
+           / jnp.float32(max(Wmax, 1)))
+    return {"halo_rows": rows, "halo_occ": occ}
 
 
 def fold_escape_sentinel(occ, escaped, cap: int, axis: str):
@@ -307,8 +329,23 @@ def _pack_rows(clen_j, poff_j, table, S: int, k, Hmax: int):
     return jnp.where((i < total) & (seg >= 0), ridx, 0)
 
 
+def chain_after(x, dep):
+    """Pin a (false) data dependency of ``x`` on ``dep`` via
+    ``optimization_barrier`` — the collective-serialization primitive of
+    the sparse exchange. XLA:CPU's rendezvous can pair the WRONG
+    collectives when two of them become runnable concurrently and the
+    per-device thread pools reach them in different orders (this
+    container's jax 0.4.x; the cross-routing class the CPU-mesh drain in
+    Simulation._drain guards against BETWEEN programs, here WITHIN one).
+    Chaining every sparse-path collective onto its predecessor pins one
+    total order on every device. Free on real TPU meshes: collectives
+    there execute in program order anyway."""
+    return jax.lax.optimization_barrier((x, dep))[0]
+
+
 def serve_sparse(fields: Sequence, covered_all, table, S: int,
-                 hmax: Tuple[int, ...], P: int, k, axis: str):
+                 hmax: Tuple[int, ...], P: int, k, axis: str,
+                 token=None):
     """Sparse halo serve: P-1 ppermute rounds, round r shipping each
     shard's packed rows to its distance-r SFC successor in a buffer of
     STATIC size hmax[r-1] — per-distance sizing is what lets the comm
@@ -316,8 +353,12 @@ def serve_sparse(fields: Sequence, covered_all, table, S: int,
     surface, distant slabs only the odd Hilbert-wrap cell) instead of a
     single max window degenerating to the whole slab
     (exchange_halos.hpp:43-119 sends exact per-peer ranges the same way).
-    Returns the annex rows [src at distance 1 | distance 2 | ...] per
-    field — row order matches localize_ranges_sparse's packed offsets."""
+    Returns (annex fields, token): annex rows [src at distance 1 |
+    distance 2 | ...] per field — row order matches
+    localize_ranges_sparse's packed offsets. ``token``: optional value
+    from the PREVIOUS serve; the rounds chain on it (and on each other)
+    through ``chain_after`` so the P-1 independent ppermutes execute in
+    one total order on every device (rendezvous-race guard)."""
     local = jnp.stack(fields, axis=1)  # (S, nf)
     nf = local.shape[1]
     parts = []
@@ -326,10 +367,13 @@ def serve_sparse(fields: Sequence, covered_all, table, S: int,
         clen, poff = _sparse_layout_dest(covered_all, dest, table, S, k)
         ridx = _pack_rows(clen, poff, table, S, k, hmax[r - 1])
         send = local[ridx]  # (Hmax_r, nf)
+        if token is not None:
+            send = chain_after(send, token)
         perm = [(i, (i + r) % P) for i in range(P)]
         parts.append(jax.lax.ppermute(send, axis, perm))
+        token = parts[-1]
     annex = jnp.concatenate(parts, axis=0) if parts else local[:0]
-    return [annex[:, f] for f in range(nf)]
+    return [annex[:, f] for f in range(nf)], token
 
 
 def _sparse_layout_dest(covered_all, dest, table, S: int, k):
@@ -411,28 +455,59 @@ def localize_ranges_sparse(
 def shard_halo_stage_sparse(x, y, z, h, keys, box, nbr, P: int,
                             hmax: Tuple[int, ...], axis: str):
     """Sparse-exchange variant of ``shard_halo_stage`` — same contract
-    (ranges, serve, jbuf, escaped), comm volume sum(hmax) rows per serve
-    instead of (P-1) * Wmax. The reference analog is exchangeHalos'
-    per-peer leaf-range p2p (exchange_halos.hpp:43-119); here the range
-    lists are implicit in the all_gathered coverage bitmaps + the
-    replicated cell table, so the negotiation is O(P * ncells) bits."""
+    (ranges, serve, jbuf, escaped, metrics), comm volume sum(hmax) rows
+    per serve instead of (P-1) * Wmax. The reference analog is
+    exchangeHalos' per-peer leaf-range p2p (exchange_halos.hpp:43-119);
+    here the range lists are implicit in the all_gathered coverage
+    bitmaps + the replicated cell table, so the negotiation is
+    O(P * ncells) bits."""
     from sphexa_tpu.sph.pallas_pairs import group_cell_ranges
 
     S = x.shape[0]
     k = jax.lax.axis_index(axis)
     table = global_cell_table(keys, nbr.level, axis)
     granges = group_cell_ranges(x, y, z, h, None, box, nbr, table=table)
-    ranges, covered_all, escaped, _ = localize_ranges_sparse(
+    ranges, covered_all, escaped, covered = localize_ranges_sparse(
         granges, table, S, P, hmax, k, axis
     )
 
+    # one total order over EVERY collective this stage issues, carried
+    # across serve calls: the chain seed is the negotiation all_gather's
+    # output, each serve's ppermute rounds link on their predecessor
+    # (chain_after — the XLA:CPU rendezvous-race guard)
+    chain = {"token": covered_all}
+
     def serve(fields):
-        return serve_sparse(fields, covered_all, table, S, hmax, P, k, axis)
+        out, tok = serve_sparse(fields, covered_all, table, S, hmax, P,
+                                k, axis, token=chain["token"])
+        chain["token"] = tok
+        return out
 
     def jbuf(own, halo):
         return tuple(jnp.concatenate([o, a]) for o, a in zip(own, halo))
 
-    return ranges, serve, jbuf, escaped
+    metrics = exchange_metrics_sparse(covered, table, S, hmax, P, k)
+    return ranges, serve, jbuf, escaped, metrics
+
+
+def exchange_metrics_sparse(covered, table, S: int,
+                            hmax: Tuple[int, ...], P: int, k):
+    """Per-shard comm telemetry of the sparse exchange, from this
+    shard's own coverage bitmap (the Bédorf-2014 LET comm-volume
+    accounting, PAPERS.md): ``halo_rows`` = the true remote rows this
+    shard needs (sum over sources of its covered cells clipped to their
+    slabs — the exchange SHIPS the static sum(hmax) regardless),
+    ``halo_occ`` = the fullest per-distance buffer's need / cap (1.0
+    means the sized cap is exactly consumed; beyond it the escape
+    sentinel discards the step)."""
+    _, _, need = _sparse_layout(covered, table, S, P)  # (P_src,)
+    src_j = jnp.arange(P, dtype=jnp.int32)
+    own = src_j == k
+    rows = jnp.sum(jnp.where(own, 0, need)).astype(jnp.int32)
+    hmax_arr = jnp.asarray((1,) + tuple(hmax), jnp.int32)  # index by r
+    caps = hmax_arr[(k - src_j) % P].astype(jnp.float32)
+    occ = jnp.max(jnp.where(own, 0.0, need.astype(jnp.float32) / caps))
+    return {"halo_rows": rows, "halo_occ": occ}
 
 
 def localize_ranges(

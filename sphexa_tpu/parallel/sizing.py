@@ -185,13 +185,16 @@ def device_halo_window(x, y, z, h, keys, box, nbr, P: int,
 
 
 @functools.partial(jax.jit, static_argnames=("nbr", "P"))
-def _sparse_halo_needs(x, y, z, h, keys, box, nbr, P: int):
-    """(P-1,) per-DISTANCE row needs of the sparse cell-granular halo
-    exchange: entry r-1 = max over shards k of the rows shard k needs
-    from its distance-r SFC predecessor (parallel/exchange.serve_sparse
-    ships round r in a buffer of exactly this size). Computed from the
-    same candidate-run coverage the in-step path uses, so the in-step
-    ``need > cap`` escape can only fire after genuine drift."""
+def sparse_need_matrix(x, y, z, h, keys, box, nbr, P: int):
+    """(P_dest, P_src) row-need matrix of the sparse cell-granular halo
+    exchange: entry [k, j] = rows shard k's covered cells clip to shard
+    j's slab (diagonal = own slab, served locally). Computed from the
+    same candidate-run coverage the in-step path uses
+    (exchange.localize_ranges_sparse), so the in-step ``need > cap``
+    escape can only fire after genuine drift — and the in-step
+    telemetry ``shard_rows`` (exchange.exchange_metrics_sparse) must
+    equal this matrix's off-diagonal row sums on an undrifted state
+    (pinned by tests/test_parallel.py)."""
     from sphexa_tpu.parallel.exchange import _cells_of_runs, _sparse_layout
     from sphexa_tpu.sph.pallas_pairs import group_cell_ranges
 
@@ -230,14 +233,22 @@ def _sparse_halo_needs(x, y, z, h, keys, box, nbr, P: int):
     diff = diff.at[dest.ravel(), c1.ravel() + 1].add(-active.ravel())
     covered = jnp.cumsum(diff, axis=1)[:, :ncells] > 0  # (P_dest, ncells)
 
-    need = jax.vmap(
+    return jax.vmap(
         lambda cov: _sparse_layout(cov, table, S, P)[2]
     )(covered)  # (P_dest, P_src)
+
+
+@functools.partial(jax.jit, static_argnames=("nbr", "P"))
+def _sparse_halo_needs(x, y, z, h, keys, box, nbr, P: int):
+    """(P-1,) per-DISTANCE row needs: entry r-1 = max over shards k of
+    the rows shard k needs from its distance-r SFC predecessor
+    (parallel/exchange.serve_sparse ships round r in a buffer of exactly
+    this size) — the per-distance fold of ``sparse_need_matrix``."""
+    need = sparse_need_matrix(x, y, z, h, keys, box, nbr, P)
     j = jnp.arange(P, dtype=jnp.int32)
-    per_r = jnp.stack(
+    return jnp.stack(
         [need[(j + r) % P, j].max() for r in range(1, P)]
     )  # (P-1,)
-    return per_r
 
 
 def device_sparse_halo(x, y, z, h, keys, box, nbr, P: int,

@@ -55,6 +55,15 @@ _SHARD_MAP_PARAMS = frozenset(
 STEP_DIAG_KEYS = ("dt", "nc_mean", "nc_max", "occupancy", "rho_max",
                   "h_max")
 
+#: Per-shard (P,) diagnostics the SHARDED force stages ride alongside the
+#: scalars — the distributed-telemetry contract (schema-v2 ``exchange`` /
+#: ``shard_load`` events). All are cheap in-graph reductions all_gathered
+#: to O(P) replicated arrays; the Simulation fetches them at its existing
+#: flush boundary, so they add ZERO host syncs to the deferred happy path
+#: (pinned by tests/test_telemetry.py). Present only on mesh runs through
+#: the pallas fast path; consumers must .get() them.
+SHARD_DIAG_KEYS = ("shard_rows", "shard_occ", "shard_work", "shard_trips")
+
 
 def shard_map(*args, **kwargs):
     """Version-compat shard_map: the replication check kwarg was renamed
@@ -335,6 +344,52 @@ def _halo_stage_fn(cfg: PropagatorConfig, nbr, P: int, S_shard: int):
     return lambda *a: ex.shard_halo_stage(*a, nbr, P, Wmax, axis)
 
 
+def exchange_fields_per_step(prop: str, av_clean: bool = False) -> int:
+    """Total f32 fields served per step by the sharded force stage — the
+    static multiplier that turns shipped rows into bytes/step
+    (telemetry ``exchange.bytes_per_step``). Counts the serve() rounds:
+    std/std-cooling = 4 (x,y,z,m) + 1 (m/rho) + 13 (h,v*,rho,p,c,cs*6);
+    ve/turb-ve = 5 (x,y,z,h,m) + 1 (xm) + 6 (kx,prho,c,v*) + 1 (divv) +
+    7 (alpha,cs*6), +3 with av_clean (gradv). Propagators without a
+    sharded pair stage (nbody) ship through GSPMD: 0 here."""
+    base = {"std": 18, "std-cooling": 18, "ve": 20, "turb-ve": 20}
+    n = base.get(prop, 0)
+    if av_clean and prop in ("ve", "turb-ve"):
+        n += 3
+    return n
+
+
+def _shard_metrics(ranges, escaped, metrics, axis: str, token=None):
+    """(P,) replicated per-shard telemetry arrays (SHARD_DIAG_KEYS) from
+    one force stage's halo-exchange products: the four per-shard scalars
+    are stacked and shipped in ONE all_gather — O(4P) floats over ICI,
+    the Warren-Salmon per-processor work accounting riding the step's
+    diagnostics. ``shard_work`` is the candidate rows this shard streams
+    per pair op (the pair-stage work proxy); everything travels as f32
+    (exact up to 2^24 — far beyond any CI-scale count, and an
+    observability quantity beyond that). ``token``: optional predecessor
+    value the gather chains on (exchange.chain_after — the XLA:CPU
+    collective-rendezvous guard; see parallel/exchange.py)."""
+    from sphexa_tpu.parallel.exchange import chain_after
+
+    work = jnp.sum(ranges.lens.astype(jnp.float32))
+    packed = jnp.stack([
+        metrics["halo_rows"].astype(jnp.float32),
+        metrics["halo_occ"].astype(jnp.float32),
+        work,
+        jnp.asarray(escaped, jnp.float32),
+    ])
+    if token is not None:
+        packed = chain_after(packed, token)
+    g = jax.lax.all_gather(packed, axis)  # (P, 4) replicated
+    return {
+        "shard_rows": g[:, 0].astype(jnp.int32),
+        "shard_occ": g[:, 1],
+        "shard_work": g[:, 2],
+        "shard_trips": g[:, 3].astype(jnp.int32),
+    }
+
+
 def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     """std pair-op stage under shard_map: per-device Mosaic kernels on the
     device's SFC slab, halos via the windowed all_to_all exchange.
@@ -369,7 +424,7 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     stage = _halo_stage_fn(cfg, nbr, P, S_shard)
 
     def forces(box, keys, x, y, z, h, m, vx, vy, vz, temp):
-        ranges, serve, jbuf, escaped = stage(x, y, z, h, keys, box)
+        ranges, serve, jbuf, escaped, hmetrics = stage(x, y, z, h, keys, box)
 
         halo1 = serve((x, y, z, m))
         rho, nc, occ = pp.pallas_density(
@@ -394,9 +449,14 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
                         halo3[6], *halo3[7:])),
             interpret=interpret,
         )
-        occ = ex.fold_escape_sentinel(occ, escaped, cfg.nbr.cap, axis)
+        # tail collectives (pmin, pmax, metrics gather) are mutually
+        # independent — chain them into one order (rendezvous guard)
         dt_c = jax.lax.pmin(dt_c, axis)
-        return rho, c, nc, occ, ax, ay, az, du, dt_c
+        occ = ex.fold_escape_sentinel(
+            ex.chain_after(occ, dt_c), escaped, cfg.nbr.cap, axis)
+        smetrics = _shard_metrics(ranges, escaped, hmetrics, axis,
+                                  token=occ)
+        return rho, c, nc, occ, ax, ay, az, du, dt_c, smetrics
 
     Pp, Pr = PartitionSpec(axis), PartitionSpec()
     # check_vma=False: pallas_call's out_shape carries no varying-axis
@@ -406,7 +466,8 @@ def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
         forces,
         mesh=cfg.mesh,
         in_specs=(Pr, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp),
-        out_specs=(Pp, Pp, Pp, Pr, Pp, Pp, Pp, Pp, Pr),
+        out_specs=(Pp, Pp, Pp, Pr, Pp, Pp, Pp, Pp, Pr,
+                   {k: Pr for k in SHARD_DIAG_KEYS}),
         check_vma=False,
     )(box, keys, state.x, state.y, state.z, state.h, state.m,
       state.vx, state.vy, state.vz, state.temp)
@@ -438,7 +499,7 @@ def _ve_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     stage = _halo_stage_fn(cfg, nbr, P, S_shard)
 
     def forces(box, min_dt, keys, x, y, z, h, m, vx, vy, vz, temp, alpha0):
-        ranges, serve, jbuf, escaped = stage(x, y, z, h, keys, box)
+        ranges, serve, jbuf, escaped, hmetrics = stage(x, y, z, h, keys, box)
 
         hx, hy, hz, hh, hm = serve((x, y, z, h, m))
         xm, nc, occ = pp.pallas_xmass(
@@ -491,17 +552,23 @@ def _ve_forces_sharded(state, box, cfg: PropagatorConfig, keys):
             ),
             interpret=interpret,
         )
-        occ = ex.fold_escape_sentinel(occ, escaped, cfg.nbr.cap, axis)
+        # tail collectives (2x pmin, pmax, metrics gather) are mutually
+        # independent — chain them into one order (rendezvous guard)
         dt_c = jax.lax.pmin(dt_c, axis)
-        dt_rho = jax.lax.pmin(dt_rho, axis)
-        return rho, c, nc, occ, ax, ay, az, du, dt_c, dt_rho, alpha
+        dt_rho = jax.lax.pmin(ex.chain_after(dt_rho, dt_c), axis)
+        occ = ex.fold_escape_sentinel(
+            ex.chain_after(occ, dt_rho), escaped, cfg.nbr.cap, axis)
+        smetrics = _shard_metrics(ranges, escaped, hmetrics, axis,
+                                  token=occ)
+        return rho, c, nc, occ, ax, ay, az, du, dt_c, dt_rho, alpha, smetrics
 
     Pp, Pr = PartitionSpec(axis), PartitionSpec()
     out = shard_map(
         forces,
         mesh=cfg.mesh,
         in_specs=(Pr, Pr, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp, Pp),
-        out_specs=(Pp, Pp, Pp, Pr, Pp, Pp, Pp, Pp, Pr, Pr, Pp),
+        out_specs=(Pp, Pp, Pp, Pr, Pp, Pp, Pp, Pp, Pr, Pr, Pp,
+                   {k: Pr for k in SHARD_DIAG_KEYS}),
         check_vma=False,
     )(box, state.min_dt, keys, state.x, state.y, state.z, state.h, state.m,
       state.vx, state.vy, state.vz, state.temp, state.alpha)
@@ -551,11 +618,11 @@ def _std_forces(
     )
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 
+    sdiag = None
     if cfg.backend == "pallas" and cfg.shard_axis is not None:
         # multi-chip fast path: per-shard Mosaic kernels under shard_map
-        (rho, c, nc, occ, ax, ay, az, du, dt_courant) = _std_forces_sharded(
-            state, box, cfg, keys
-        )
+        (rho, c, nc, occ, ax, ay, az, du, dt_courant,
+         sdiag) = _std_forces_sharded(state, box, cfg, keys)
     elif cfg.backend == "pallas":
         # fused search+op TPU kernels: one shared cell-range prologue,
         # neighbor lists never materialize (sph/pallas_pairs.py)
@@ -605,6 +672,8 @@ def _std_forces(
         extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
     if ldiag is not None:
         gdiag = {**(gdiag or {}), **ldiag}
+    if sdiag is not None:
+        gdiag = {**(gdiag or {}), **sdiag}
 
     return (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ,
             rho, c, gdiag, aux)
@@ -701,10 +770,11 @@ def _ve_forces(
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
     vx, vy, vz = state.vx, state.vy, state.vz
 
+    sdiag = None
     if cfg.backend == "pallas" and cfg.shard_axis is not None:
         # multi-chip fast path: per-shard Mosaic kernels + windowed halos
         (rho, c, nc, occ, ax, ay, az, du, dt_courant, dt_rho,
-         alpha) = _ve_forces_sharded(state, box, cfg, keys)
+         alpha, sdiag) = _ve_forces_sharded(state, box, cfg, keys)
     elif cfg.backend == "pallas":
         # fused search+op TPU engine for the full VE sequence — the
         # reference's flagship propagator (ve_hydro.hpp:131-208) on the
@@ -794,6 +864,8 @@ def _ve_forces(
         extra_dts, gdiag = (dt_acc,), {**gdiag, "egrav": egrav}
     if ldiag is not None:
         gdiag = {**(gdiag or {}), **ldiag}
+    if sdiag is not None:
+        gdiag = {**(gdiag or {}), **sdiag}
 
     dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts, const=const)
     return state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag

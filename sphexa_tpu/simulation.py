@@ -15,7 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sphexa_tpu.telemetry import Telemetry
+from sphexa_tpu.telemetry import Telemetry, emit_memory_event
 
 from sphexa_tpu.gravity.traversal import GravityConfig, estimate_gravity_caps
 from sphexa_tpu.gravity.tree import build_gravity_tree
@@ -261,6 +261,7 @@ class Simulation:
         donate: object = "auto",
         debug_checks: bool = False,
         telemetry: Optional[Telemetry] = None,
+        imbalance_ratio: float = 1.5,
     ):
         # telemetry registry: every driver-visible control-flow event
         # (reconfigure/rollback/replay/retrace) and step timing reports
@@ -272,6 +273,17 @@ class Simulation:
         # path (pinned by tests/test_telemetry.py's no-sync guard).
         self.telemetry = telemetry if telemetry is not None else Telemetry()
         self._window_t0 = None  # host stamp of the open window's 1st launch
+        # distributed observability (schema v2): the imbalance watchdog
+        # fires a first-class event when max/mean of a per-shard metric
+        # (pair work, halo rows, halo occupancy) crosses this ratio —
+        # the runtime mirror of the retrace watchdog, for the quantity
+        # the tree-code lineage says scaling lives on (Warren-Salmon
+        # per-processor work accounting, PAPERS.md)
+        self._imbalance_ratio = float(imbalance_ratio)
+        # static shape of the active halo exchange (mode + shipped rows),
+        # stamped by _configure_sharded for the exchange events
+        self._halo_info: Optional[Dict] = None
+        self._mem_post_compile = False  # one "post-compile" HBM snapshot
         self.state = state
         self.box = box
         self.const = const
@@ -542,6 +554,27 @@ class Simulation:
             aux_cfg = self.turb_cfg
         elif self.prop_name == "std-cooling":
             aux_cfg = self.cooling_cfg
+        # static exchange shape for telemetry: shipped rows per serve is
+        # a config-time constant (the measure_multichip.py size formula),
+        # bytes/step = rows x per-propagator serve fields x 4B
+        from sphexa_tpu.propagator import exchange_fields_per_step
+
+        P = self._mesh.size
+        S = self.state.n // P
+        nf = exchange_fields_per_step(self.prop_name, self.av_clean)
+        if hcells:
+            shipped = int(sum(min(c, S) for c in hcells))
+            self._halo_info = {"mode": "sparse", "caps": tuple(hcells),
+                               "shipped_rows": shipped}
+        elif self._cfg.backend == "pallas" and self.prop_name != "nbody":
+            w = min(wmax, S) or S
+            self._halo_info = {"mode": "windowed", "wmax": w,
+                               "shipped_rows": (P - 1) * w}
+        else:
+            # GSPMD path: XLA owns the collectives, no explicit exchange
+            self._halo_info = {"mode": "gspmd", "shipped_rows": 0}
+        self._halo_info["bytes_per_step"] = (
+            self._halo_info["shipped_rows"] * nf * 4)
         self._stepper = make_sharded_step(
             self._mesh, self._cfg, _PROPAGATORS[self.prop_name],
             halo_window=wmax, halo_cells=hcells, aux_cfg=aux_cfg,
@@ -876,8 +909,15 @@ class Simulation:
 
     @staticmethod
     def _scalar_view(diagnostics) -> Dict:
+        """Scalars + the tiny (P,) per-shard telemetry arrays
+        (SHARD_DIAG_KEYS) — everything the flush boundary fetches in one
+        batch. Per-particle arrays (keep_fields/keep_accels) stay on
+        device."""
+        from sphexa_tpu.propagator import SHARD_DIAG_KEYS
+
         return {
-            k: v for k, v in diagnostics.items() if getattr(v, "ndim", 0) == 0
+            k: v for k, v in diagnostics.items()
+            if getattr(v, "ndim", 0) == 0 or k in SHARD_DIAG_KEYS
         }
 
     @classmethod
@@ -893,6 +933,76 @@ class Simulation:
             or self._gravity_overflowed(diagnostics)
             or not self._lists_fresh(diagnostics)
         )
+
+    def _emit_distributed(self, diagnostics, steps: int) -> None:
+        """Schema-v2 distributed telemetry at the fetch boundary: one
+        ``shard_load`` + one ``exchange`` event per checked step / clean
+        window, plus the imbalance watchdog. ``diagnostics`` is the
+        already-FETCHED dict — everything here is host arithmetic on
+        (P,) numpy arrays, so the deferred-window zero-sync contract is
+        untouched (pinned by tests/test_telemetry.py)."""
+        if self._mesh is None:
+            return
+        tel = self.telemetry
+        P = self._mesh.size
+        particles = [self.state.n // P] * P  # equal SFC slabs by design
+
+        def arr(key):
+            v = diagnostics.get(key)
+            return None if v is None else np.asarray(v)
+
+        work, rows, occ = arr("shard_work"), arr("shard_rows"), \
+            arr("shard_occ")
+        # per-shard trips reaching this point are always zero — a tripped
+        # sentinel folds into occupancy==cap+1 and the step/window is
+        # discarded before any emit; halo_trips is counted at the ONE
+        # place that sees the sentinel (_reconfigure_after_overflow)
+        load = {"it": self.iteration, "steps": steps,
+                "particles": particles}
+        if work is not None:
+            load["work"] = [float(w) for w in work]
+        tel.event("shard_load", **load)
+        info = self._halo_info or {}
+        if rows is not None:
+            tel.event(
+                "exchange", it=self.iteration, steps=steps,
+                mode=info.get("mode", "?"),
+                shipped_rows=int(info.get("shipped_rows", 0)),
+                rows=[int(r) for r in rows],
+                occ=None if occ is None else [round(float(o), 4)
+                                              for o in occ],
+                bytes_per_step=int(info.get("bytes_per_step", 0)),
+                trips=int(tel.counters.get("halo_trips", 0)),
+            )
+        # the watchdog: max/mean per metric against the configured ratio
+        for metric, a in (("work", work), ("halo_rows", rows),
+                          ("halo_occ", occ)):
+            if a is None or a.size == 0:
+                continue
+            mean = float(a.mean())
+            if mean <= 0.0:
+                continue
+            ratio = float(a.max()) / mean
+            if ratio >= self._imbalance_ratio:
+                tel.count("imbalances")
+                tel.event("imbalance", it=self.iteration, metric=metric,
+                          ratio=round(ratio, 4),
+                          threshold=self._imbalance_ratio)
+
+    def _emit_memory(self, point: str) -> None:
+        """Per-device HBM snapshot event (telemetry/memory.py): host
+        allocator metadata only, never a device sync. ``post-compile``
+        fires once (after the first fetched step/window — executable +
+        workspace resident); ``flush`` at every window flush."""
+        if point == "post-compile":
+            if self._mem_post_compile:
+                return
+            self._mem_post_compile = True
+        devices = None
+        if self._mesh is not None:
+            devices = list(self._mesh.devices.flat)
+        emit_memory_event(self.telemetry, point, devices=devices,
+                          it=self.iteration)
 
     @staticmethod
     def _lists_fresh(diagnostics) -> bool:
@@ -912,6 +1022,10 @@ class Simulation:
             # cell-cap overflows, which would inflate comm volume for the
             # rest of the run
             self._halo_margin *= 1.5
+            # every sentinel trip is telemetry: the exchange events stamp
+            # the cumulative count so a drift-heavy run's resize churn is
+            # visible in the record, not just in wall time
+            self.telemetry.count("halo_trips")
         # occ == cap+1 is the window-blowout SENTINEL, not a real
         # occupancy — feeding it back as min_cap would ratchet the cap
         # (and force a fresh compile) on every blowout; a plain
@@ -972,6 +1086,8 @@ class Simulation:
             dt=float(result["dt"]) if "dt" in result else None,
             reconfigured=bool(reconfigured),
         )
+        self._emit_distributed(diagnostics, steps=1)
+        self._emit_memory("post-compile")
         if self.debug_checks:
             # first triggered checkify predicate of THIS step ("" = all
             # NaN/Inf/OOB checks passed); .get() syncs, which is the
@@ -1050,6 +1166,11 @@ class Simulation:
                 wall_s=round(window_wall, 6),
                 per_step_s=round(window_wall / len(pending), 6),
             )
+            # distributed telemetry rides the SAME fetch: per-shard
+            # load/exchange events + HBM snapshot, at window granularity
+            self._emit_distributed(fetched[-1], steps=len(pending))
+            self._emit_memory("post-compile")
+            self._emit_memory("flush")
             diagnostics = {**pending[-1], **fetched[-1]}
             result = {
                 k: np.asarray(v) if getattr(v, "ndim", 0) else float(v)

@@ -31,6 +31,11 @@ from sphexa_tpu.telemetry.manifest import (
     read_manifest,
     write_manifest,
 )
+from sphexa_tpu.telemetry.memory import (
+    device_memory_snapshot,
+    emit_memory_event,
+    save_memory_profile,
+)
 from sphexa_tpu.telemetry.registry import (
     EVENT_KINDS,
     SCHEMA_VERSION,
@@ -53,4 +58,7 @@ __all__ = [
     "build_manifest",
     "write_manifest",
     "read_manifest",
+    "device_memory_snapshot",
+    "emit_memory_event",
+    "save_memory_profile",
 ]

@@ -1,19 +1,31 @@
 """``sphexa-telemetry``: summarize a telemetry run or diff two of them.
 
     sphexa-telemetry summary <run-dir> [--format text|json] [--strict]
+    sphexa-telemetry shards  <run-dir> [--format text|json]
     sphexa-telemetry diff <baseline> <candidate> [--threshold F]
 
 ``summary`` reads ``<run-dir>/manifest.json`` + ``events.jsonl`` and
 reports p50/p95/mean step time, retrace/rollback/reconfigure counts and
-per-phase means. ``--strict`` exits 1 on any schema-invalid event (the
-check.sh --telemetry-only gate).
+per-phase means. ``--strict`` exits 1 on any schema-invalid event or
+unknown event kind (the check.sh --telemetry-only gate); unknown kinds
+are COUNTED and reported either way, never silently dropped — a v2
+reader meeting a future file degrades loudly.
+
+``shards`` is the multi-chip view (schema-v2 ``shard_load`` /
+``exchange`` / ``memory`` / ``imbalance`` events): per-shard load table,
+halo-occupancy p95, comm rows + bytes/step, escape-trip counts, and
+per-device HBM snapshots. Exit 1 when the run carries no per-shard
+telemetry (so a mesh-rehearsal smoke can assert the instrumentation
+actually fired).
 
 ``diff`` compares two run directories, two bench JSONs (``bench.py``
-output or the ``BENCH_r*.json`` driver wrapper), or a run against a
+output, the ``BENCH_r*.json`` driver wrapper, or the
+``MULTICHIP_r*.json`` wrapper whose tail carries
+``scripts/measure_multichip.py --json``'s line), or a run against a
 bench baseline (throughput derived as particles / p50 step time). Exit
 codes are CI-shaped: 0 within threshold, 1 regression beyond it, 2
-usage/unreadable input — so a pipeline can gate on step-time
-regressions directly.
+usage/unreadable input — so a pipeline can gate on step-time or
+comm-volume regressions directly.
 
 Deliberately jax-free: summarizing a run must not drag in a backend.
 """
@@ -22,13 +34,14 @@ import argparse
 import json
 import os
 import sys
+from collections import Counter
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from sphexa_tpu.devtools.common import render_table
 from sphexa_tpu.telemetry.manifest import read_manifest
-from sphexa_tpu.telemetry.registry import validate_event
+from sphexa_tpu.telemetry.registry import EVENT_KINDS, validate_event
 
 
 class TelemetryError(Exception):
@@ -109,6 +122,12 @@ def summarize_run(run_dir: str) -> Dict:
             "mean_s": float(arr.mean()),
             "max_s": float(arr.max()),
         }
+    # forward compat: kinds this reader does not know are counted and
+    # surfaced, not silently skipped (a v1 reader on a v2 file used to
+    # drop exchange/shard_load/... without a trace)
+    unknown_kinds = Counter(
+        e.get("kind") for e in events if e.get("kind") not in EVENT_KINDS
+    )
     return {
         "run_dir": run_dir,
         "manifest": read_manifest(run_dir),
@@ -126,15 +145,108 @@ def summarize_run(run_dir: str) -> Dict:
         # mid-run health signal — only non-initial rebuilds count
         "reconfigures": len([e for e in _of_kind(events, "reconfigure")
                              if e.get("reason") != "initial"]),
+        "imbalances": len(_of_kind(events, "imbalance")),
         "phase_mean_s": {k: float(np.mean(v)) for k, v in sorted(
             phases.items())},
+        "unknown_kinds": {str(k): int(n)
+                          for k, n in sorted(unknown_kinds.items())},
+        "schema_problems": problems,
+    }
+
+
+# ---------------------------------------------------------------------------
+# shards view (schema v2 distributed events)
+# ---------------------------------------------------------------------------
+
+
+def _per_shard_matrix(events: List[dict], key: str) -> Optional[np.ndarray]:
+    """(n_events, P) float matrix of one per-shard list field; None when
+    the field never appears. Ragged rows (a mid-run mesh change would be
+    a different run anyway) are dropped rather than guessed at."""
+    rows = [e[key] for e in events
+            if isinstance(e.get(key), list) and e[key]]
+    if not rows:
+        return None
+    width = len(rows[-1])
+    rows = [r for r in rows if len(r) == width]
+    try:
+        return np.asarray(rows, dtype=np.float64)
+    except (TypeError, ValueError):
+        return None
+
+
+def summarize_shards(run_dir: str) -> Dict:
+    """Aggregate the distributed (schema-v2) events of one run into the
+    per-shard view: load/work per shard, halo-exchange volume and
+    occupancy percentiles, escape trips, imbalance-watchdog hits, and
+    per-device HBM snapshots."""
+    events, problems = load_events(run_dir)
+    loads = _of_kind(events, "shard_load")
+    exchanges = _of_kind(events, "exchange")
+    memories = _of_kind(events, "memory")
+    imbalances = _of_kind(events, "imbalance")
+
+    particles = _per_shard_matrix(loads, "particles")
+    work = _per_shard_matrix(loads, "work")
+    rows = _per_shard_matrix(exchanges, "rows")
+    occ = _per_shard_matrix(exchanges, "occ")
+
+    shards: List[Dict] = []
+    P = 0
+    for m in (particles, work, rows, occ):
+        if m is not None:
+            P = max(P, m.shape[1])
+    for s in range(P):
+        col = lambda m: None if m is None or s >= m.shape[1] else m[:, s]
+        w = col(work)
+        r = col(rows)
+        o = col(occ)
+        shards.append({
+            "shard": s,
+            "particles": int(particles[-1, s]) if particles is not None
+            else None,
+            "work_mean": float(w.mean()) if w is not None else None,
+            "rows_mean": float(r.mean()) if r is not None else None,
+            "occ_p95": float(np.percentile(o, 95)) if o is not None
+            else None,
+        })
+    if work is not None and all(s["work_mean"] is not None for s in shards):
+        total = sum(s["work_mean"] for s in shards) or 1.0
+        for s in shards:
+            s["work_share"] = s["work_mean"] / total
+    last_ex = exchanges[-1] if exchanges else {}
+    # imbalance ratios over the run: max/mean of work per event row
+    ratios = []
+    if work is not None:
+        means = work.mean(axis=1)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            ratios = list(work.max(axis=1)[means > 0] / means[means > 0])
+    return {
+        "run_dir": run_dir,
+        "manifest": read_manifest(run_dir),
+        "shards": shards,
+        "windows": len(exchanges),
+        "mode": last_ex.get("mode"),
+        "shipped_rows": last_ex.get("shipped_rows"),
+        "bytes_per_step": last_ex.get("bytes_per_step"),
+        "trips": last_ex.get("trips", 0),
+        "imbalance_events": len(imbalances),
+        "work_ratio_p95": float(np.percentile(ratios, 95)) if ratios
+        else None,
+        "memory": [
+            {k: e.get(k) for k in ("point", "it", "devices",
+                                   "bytes_in_use", "peak_bytes_in_use")}
+            for e in memories
+        ],
         "schema_problems": problems,
     }
 
 
 def _parse_bench_json(path: str) -> Dict:
-    """bench.py's JSON line, or the driver's BENCH_r*.json wrapper whose
-    ``tail`` buries that line in captured output."""
+    """bench.py's JSON line, or a driver wrapper (``BENCH_r*.json`` /
+    ``MULTICHIP_r*.json``) whose ``tail`` buries a metric/value line in
+    captured output (measure_multichip.py --json emits the same shape,
+    so multi-chip comm-volume rounds diff exactly like bench rounds)."""
     with open(path) as f:
         data = json.load(f)
     if "metric" in data and "value" in data:
@@ -217,14 +329,22 @@ def diff_sides(base: Dict, cand: Dict, threshold: float) -> Dict:
                 b["phase_mean_s"][k], higher_is_better=False)
     elif base["type"] == "bench" and cand["type"] == "bench":
         a, b = base["bench"], cand["bench"]
-        row("updates_per_sec", a.get("value"), b.get("value"),
+        # the headline is whatever the bench line's metric is: throughput
+        # for bench.py, a saving ratio for measure_multichip --json —
+        # both higher-is-better by construction
+        label = ("saving" if "saving" in str(a.get("metric", ""))
+                 else "updates_per_sec")
+        row(label, a.get("value"), b.get("value"),
             higher_is_better=True, headline=True)
         ea, eb = a.get("extra", {}) or {}, b.get("extra", {}) or {}
         for k in sorted(set(ea) & set(eb)):
             if isinstance(ea[k], (int, float)) and isinstance(
                     eb[k], (int, float)):
+                # throughput/saving metrics improve upward; everything
+                # else (times, comm rows/fractions, byte counts) downward
                 row(k, ea[k], eb[k],
-                    higher_is_better="updates_per_sec" in k)
+                    higher_is_better="updates_per_sec" in k
+                    or "saving" in k)
     else:
         # mixed: throughput is the one commensurable axis
         def ups(side):
@@ -287,7 +407,84 @@ def render_summary(s: Dict) -> str:
     ]
     for k, v in s["phase_mean_s"].items():
         rows.append((f"phase {k} (mean)", _fmt_s(v)))
+    if s.get("imbalances"):
+        rows.append(("imbalance events", s["imbalances"]))
     lines.append(render_table(rows))
+    for kind, n in s.get("unknown_kinds", {}).items():
+        lines.append(f"  unknown kind: {kind} x{n} (newer writer? "
+                     f"upgrade this reader)")
+    for p in s["schema_problems"]:
+        lines.append(f"  schema: {p}")
+    return "\n".join(lines)
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024
+    return f"{v:.1f} GiB"
+
+
+def render_shards(s: Dict) -> str:
+    m = s.get("manifest") or {}
+    lines = [f"run: {s['run_dir']}"]
+    if m:
+        lines.append(
+            f"  devices {m.get('device_count', '?')}"
+            + (f"  mesh {m['mesh_shape']}" if m.get("mesh_shape") else "")
+            + (f"  N={m['particles']}" if m.get("particles") else "")
+            + f"  backend {m.get('backend', '?')}"
+        )
+    if not s["shards"]:
+        lines.append("  no per-shard telemetry in this run "
+                     "(single-device, or a pre-v2 writer)")
+        return "\n".join(lines)
+    fmt = lambda v, f="{:.3g}": "-" if v is None else f.format(v)
+    rows = []
+    for sh in s["shards"]:
+        rows.append((
+            sh["shard"],
+            fmt(sh["particles"], "{}"),
+            fmt(sh["work_mean"], "{:.4g}"),
+            fmt(sh.get("work_share"), "{:.1%}"),
+            fmt(sh["rows_mean"], "{:.4g}"),
+            fmt(sh["occ_p95"], "{:.2f}"),
+        ))
+    lines.append(render_table(
+        rows, headers=("shard", "particles", "work", "share", "halo rows",
+                       "occ p95")))
+    info = [
+        ("windows recorded", s["windows"]),
+        ("exchange mode", s.get("mode") or "-"),
+        ("shipped rows/serve", s.get("shipped_rows") or "-"),
+        ("bytes/step", _fmt_bytes(s.get("bytes_per_step"))
+         if s.get("bytes_per_step") else "-"),
+        ("escape trips", s.get("trips", 0)),
+        ("imbalance events", s.get("imbalance_events", 0)),
+    ]
+    if s.get("work_ratio_p95") is not None:
+        info.append(("work max/mean p95", f"{s['work_ratio_p95']:.3f}"))
+    lines.append(render_table(info))
+    if s["memory"]:
+        lines.append("memory snapshots:")
+        mrows = []
+        for e in s["memory"]:
+            bts = e.get("bytes_in_use") or []
+            pks = e.get("peak_bytes_in_use") or []
+            mrows.append((
+                e.get("point", "?"),
+                e.get("it", "-"),
+                len(e.get("devices") or []),
+                _fmt_bytes(max(bts)) if bts else "-",
+                _fmt_bytes(max(pks)) if pks else "-",
+            ))
+        lines.append(render_table(
+            mrows, headers=("point", "it", "devices", "max bytes",
+                            "max peak")))
     for p in s["schema_problems"]:
         lines.append(f"  schema: {p}")
     return "\n".join(lines)
@@ -326,7 +523,12 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("run_dir")
     ps.add_argument("--format", choices=("text", "json"), default="text")
     ps.add_argument("--strict", action="store_true",
-                    help="exit 1 on any schema-invalid event")
+                    help="exit 1 on any schema-invalid event or unknown "
+                         "event kind")
+    ph = sub.add_parser(
+        "shards", help="per-shard load/comm/HBM view of a multi-chip run")
+    ph.add_argument("run_dir")
+    ph.add_argument("--format", choices=("text", "json"), default="text")
     pd = sub.add_parser("diff", help="diff candidate against baseline")
     pd.add_argument("baseline", help="run dir or bench JSON")
     pd.add_argument("candidate", help="run dir or bench JSON")
@@ -343,7 +545,15 @@ def main(argv=None) -> int:
             s = summarize_run(args.run_dir)
             print(json.dumps(s, indent=2) if args.format == "json"
                   else render_summary(s))
-            return 1 if (args.strict and s["schema_problems"]) else 0
+            return 1 if (args.strict and (s["schema_problems"]
+                                          or s["unknown_kinds"])) else 0
+        if args.cmd == "shards":
+            s = summarize_shards(args.run_dir)
+            print(json.dumps(s, indent=2) if args.format == "json"
+                  else render_shards(s))
+            # a mesh smoke asserting the instrumentation fired needs a
+            # distinct exit code for "run exists but no shard telemetry"
+            return 0 if s["shards"] else 1
         d = diff_sides(load_side(args.baseline), load_side(args.candidate),
                        args.threshold)
         print(json.dumps(d, indent=2) if args.format == "json"

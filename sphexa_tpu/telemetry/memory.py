@@ -1,0 +1,83 @@
+"""Per-device HBM accounting: memory_stats() snapshots as telemetry.
+
+The multi-chip campaign's memory question is per-DEVICE: a 64M run on
+v5e-16 lives or dies on the worst shard's peak, not the mean
+(scripts/measure_hbm.py extrapolates 4M particles/chip against 16 GiB).
+This module is the one place that folds ``device.memory_stats()`` into
+the event stream, at three well-defined points:
+
+- ``manifest``: right after Simulation construction (app/main.py) — the
+  pre-compile residency (state arrays + constants);
+- ``post-compile``: after the first step's fetch completes — first
+  executable + workspace are resident, the number reconfigures grow from;
+- ``flush``: at each deferred-window flush — the steady-state peak.
+
+Host-side allocator metadata only: ``memory_stats()`` never syncs the
+device stream, so snapshots are legal inside the zero-sync deferred
+window (pinned by tests/test_telemetry.py). Backends without allocator
+stats (CPU) report empty byte lists — the events still mark the points
+so CPU-mesh rehearsals validate the same schema the chip run writes.
+"""
+
+from typing import Dict, List, Optional
+
+#: memory_stats() keys folded into the snapshot, in event-field order
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_snapshot(devices=None) -> Dict[str, List]:
+    """Per-device allocator stats: ``{"devices": [...], "bytes_in_use":
+    [...], "peak_bytes_in_use": [...], "bytes_limit": [...]}``. Lists are
+    parallel over devices; byte lists are empty when NO device reports
+    stats (CPU), and 0-filled per device that individually lacks a key.
+    Never raises — a telemetry probe must not sink the run it measures."""
+    try:
+        import jax
+
+        devices = list(devices) if devices is not None \
+            else jax.local_devices()
+    except Exception:
+        return {"devices": [], **{k: [] for k in _STAT_KEYS}}
+    names: List[str] = []
+    stats: List[dict] = []
+    for d in devices:
+        names.append(str(getattr(d, "id", d)))
+        try:
+            stats.append(d.memory_stats() or {})
+        except Exception:
+            stats.append({})
+    out: Dict[str, List] = {"devices": names}
+    if any(stats):
+        for k in _STAT_KEYS:
+            out[k] = [int(s.get(k, 0)) for s in stats]
+    else:
+        for k in _STAT_KEYS:
+            out[k] = []
+    return out
+
+
+def emit_memory_event(telemetry, point: str, devices=None,
+                      **extra) -> Optional[Dict[str, List]]:
+    """Snapshot + emit one ``memory`` event (kind schema v2). Skipped
+    entirely on a sink-less registry: the snapshot exists to be
+    persisted, and a counter bump alone is not worth P devices' stat
+    calls per flush. Returns the snapshot (None when skipped)."""
+    if telemetry is None or not telemetry.sinks:
+        return None
+    snap = device_memory_snapshot(devices)
+    telemetry.event("memory", point=point, **snap, **extra)
+    return snap
+
+
+def save_memory_profile(path: str) -> bool:
+    """Opt-in ``jax.profiler`` device-memory-profile dump (pprof format):
+    the allocation-site breakdown behind a surprising snapshot number.
+    Returns whether a file was written (False when jax or the profiler
+    is unavailable — callers report, never crash)."""
+    try:
+        import jax
+
+        jax.profiler.save_device_memory_profile(path)
+        return True
+    except Exception:
+        return False

@@ -20,8 +20,14 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 #: events.jsonl schema version; bump on any incompatible field change and
-#: document the migration in docs/OBSERVABILITY.md.
-SCHEMA_VERSION = 1
+#: document the migration in docs/OBSERVABILITY.md. v2 added the
+#: distributed kinds (exchange / shard_load / memory / imbalance) and
+#: changed nothing about the v1 kinds, so v2 readers accept v1 files.
+SCHEMA_VERSION = 2
+
+#: event schema versions this reader understands (older versions only
+#: ever ADD kinds, so the per-kind field table below covers them all)
+SUPPORTED_VERSIONS = (1, 2)
 
 #: every event kind the schema admits, with its required payload fields
 #: (beyond the envelope ``v``/``seq``/``t``/``kind``). The CLI's --strict
@@ -39,30 +45,61 @@ EVENT_KINDS: Dict[str, tuple] = {
     "trace": ("dir",),            # jax.profiler trace started
     "run_end": (),
     "note": (),
+    # -- v2: distributed kinds (one run, P shards) ------------------------
+    # per-window halo-exchange record: ``rows`` = per-shard TRUE candidate
+    # need (device-measured), ``shipped_rows`` = the static sized volume
+    # actually moved per serve (sum(hmax) sparse / (P-1)*Wmax windowed)
+    "exchange": ("it", "shipped_rows", "rows"),
+    # per-window load record: per-shard particle counts + work proxies
+    "shard_load": ("it", "particles"),
+    # per-device HBM snapshot at a named point (manifest / post-compile /
+    # flush); bytes lists are empty on backends without memory_stats()
+    "memory": ("point",),
+    # imbalance watchdog: max/mean of a per-shard metric crossed the
+    # configured ratio (the runtime analog of the retrace watchdog)
+    "imbalance": ("it", "metric", "ratio", "threshold"),
 }
+
+#: kinds that already existed in schema v1 (a v1 event carrying a
+#: v2-only kind is writer confusion, not forward compatibility)
+V1_KINDS = frozenset(EVENT_KINDS) - {
+    "exchange", "shard_load", "memory", "imbalance"}
 
 
 def _jsonable(v):
-    """Coerce numpy scalars so sinks can json.dumps payloads directly."""
+    """Coerce numpy scalars/arrays so sinks can json.dumps payloads
+    directly (per-shard metrics arrive as small (P,) arrays)."""
     if isinstance(v, (np.floating, np.integer)):
         return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
     return v
 
 
 def validate_event(e: dict) -> List[str]:
-    """Schema-v1 problems with one event dict ([] = valid)."""
+    """Schema problems with one event dict ([] = valid). Any supported
+    version validates (v2 readers accept v1 files). An UNKNOWN kind is
+    deliberately NOT a problem here — unknownness is the forward-compat
+    dimension the reader reports separately (summary's
+    ``unknown_kinds`` counts, strict exit code), and flagging it twice
+    would render every future-schema event as schema-invalid noise. A
+    v2-only kind claiming ``v: 1`` IS a problem (writer confusion, not
+    forward compat)."""
     problems = []
     if not isinstance(e, dict):
         return ["event is not an object"]
-    if e.get("v") != SCHEMA_VERSION:
+    if e.get("v") not in SUPPORTED_VERSIONS:
         problems.append(f"bad schema version {e.get('v')!r}")
     kind = e.get("kind")
-    if kind not in EVENT_KINDS:
-        problems.append(f"unknown kind {kind!r}")
-    else:
-        for field in EVENT_KINDS[kind]:
-            if field not in e:
-                problems.append(f"{kind} event missing field {field!r}")
+    if kind in EVENT_KINDS:
+        if e.get("v") == 1 and kind not in V1_KINDS:
+            problems.append(f"v2-only kind {kind!r} on a v1 event")
+        else:
+            for field in EVENT_KINDS[kind]:
+                if field not in e:
+                    problems.append(f"{kind} event missing field {field!r}")
     for field in ("seq", "t"):
         if not isinstance(e.get(field), (int, float)):
             problems.append(f"missing/non-numeric envelope field {field!r}")

@@ -56,7 +56,8 @@ class JsonlSink:
 #: event kinds worth a human line (the exceptional-control-flow ones a
 #: console reader actually wants to see; per-step launch/phases spam is
 #: left to the JSONL record)
-_NOTABLE = ("reconfigure", "rollback", "replay", "retrace", "trace")
+_NOTABLE = ("reconfigure", "rollback", "replay", "retrace", "trace",
+            "imbalance")
 
 
 class ConsoleSink:

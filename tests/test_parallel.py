@@ -900,8 +900,26 @@ class TestSparseHaloExchange:
 
     def test_sparse_std_matches_single_partial_windows(self):
         """One std step, 8 shards, sparse exchange in the partial-cap
-        regime vs the single-device step."""
+        regime vs the single-device step.
+
+        Also the regression pin for the XLA:CPU collective-rendezvous
+        race (this container's jax 0.4.x): the sparse stage issues ~P^2
+        mutually independent collectives (P-1 ppermutes per serve x 3
+        serves + gathers/psums), and unchained they could rendezvous in
+        different orders across the oversubscribed virtual devices —
+        every shard's coverage/need then collapsed to shard 0's values,
+        tripping the escape sentinel with ZERO drift (occupancy ==
+        cap+1, the historical failure of this test) and NaN-ing the
+        positions. exchange.chain_after now pins one total order; the
+        per-shard telemetry assertions below would fail first under any
+        recurrence (the race's signature: all shards reporting shard
+        0's need row)."""
+        import dataclasses
+
+        from sphexa_tpu.parallel import sizing
         from sphexa_tpu.propagator import step_hydro_std
+        from sphexa_tpu.sfc.box import make_global_box
+        from sphexa_tpu.sfc.keys import compute_sfc_keys
 
         state, box, const = init_sedov(40)
         cfg = make_propagator_config(state, box, const, backend="pallas")
@@ -926,6 +944,25 @@ class TestSparseHaloExchange:
         np.testing.assert_allclose(
             float(out_diag["dt"]), float(ref_diag["dt"]), rtol=1e-5
         )
+        # per-shard exchange telemetry (SHARD_DIAG_KEYS) vs the sizing
+        # pass's independently computed need matrix — the schema-v2
+        # exchange-event acceptance check AND the rendezvous-race canary
+        gbox = make_global_box(state.x, state.y, state.z, box)
+        keys = compute_sfc_keys(state.x, state.y, state.z, gbox)
+        nbr = cfg.nbr
+        if nbr.run_cap > S:
+            nbr = dataclasses.replace(nbr, run_cap=S)
+        need = np.asarray(jax.device_get(sizing.sparse_need_matrix(
+            state.x, state.y, state.z, state.h, keys, gbox, nbr, 8)))
+        expected_rows = [int(need[k].sum() - need[k, k]) for k in range(8)]
+        rows = np.asarray(out_diag["shard_rows"])
+        assert rows.tolist() == expected_rows
+        assert len(set(rows.tolist())) > 1  # genuinely per-shard
+        occ = np.asarray(out_diag["shard_occ"])
+        assert occ.shape == (8,) and float(occ.max()) <= 1.0 + 1e-6
+        work = np.asarray(out_diag["shard_work"])
+        assert work.shape == (8,) and (work > 0).all()
+        assert np.asarray(out_diag["shard_trips"]).sum() == 0
 
     def test_sparse_escape_sentinel_trips(self):
         """Undersized per-distance caps must surface as the occupancy

@@ -76,7 +76,15 @@ class TestRegistry:
               "it": 1, "wall_s": 0.1}
         assert validate_event(ok) == []
         assert validate_event({**ok, "v": 99})
-        assert validate_event({**ok, "kind": "bogus"})
+        # an unknown kind is NOT a schema problem: it is the
+        # forward-compat dimension the summary counts separately
+        # (unknown_kinds + strict exit code) — flagging it here too
+        # would double-report every future-schema event
+        assert validate_event({**ok, "kind": "bogus"}) == []
+        # ...but a v2-only kind claiming v1 is writer confusion
+        assert validate_event({"v": 1, "seq": 0, "t": 1.0,
+                               "kind": "exchange", "it": 1,
+                               "shipped_rows": 1, "rows": [1]})
         bad = dict(ok)
         del bad["wall_s"]
         assert any("wall_s" in p for p in validate_event(bad))
@@ -179,8 +187,15 @@ class TestSimulationTelemetry:
         """A deferred-detected overflow must surface as first-class
         rollback/replay telemetry (it used to be visible only as
         ``reconfigured`` on one diagnostics dict), and the forced
-        reconfigure's fresh compile must trip the retrace watchdog."""
-        state, box, const = init_sedov(12)
+        reconfigure's fresh compile must trip the retrace watchdog.
+
+        side 14 deliberately: the retrace assertion needs this test's
+        executables to be UNIQUE in the process — test_simulation_async
+        doctors the identical sedov(12)/block-4096/cap-8 config, and
+        with the global jit caches pre-warmed by it (alphabetical suite
+        order) every launch here would see a zero cache delta and the
+        watchdog would correctly report nothing."""
+        state, box, const = init_sedov(14)
         sink = MemorySink()
         sim = Simulation(state, box, const, prop="std", block=4096,
                          check_every=3, telemetry=Telemetry(sinks=[sink]))
@@ -221,6 +236,154 @@ class TestSimulationTelemetry:
         sim = _sedov_sim(side=8)
         sim.run(1, log_every=1, printer=lines.append)
         assert len(lines) == 1 and "rho_max=" in lines[0]
+
+
+# ---------------------------------------------------------------------------
+# distributed telemetry (schema v2): sharded no-sync guard, shard events,
+# imbalance watchdog, memory snapshots
+# ---------------------------------------------------------------------------
+
+
+class TestDistributedTelemetry:
+    def test_sharded_window_sync_free_emits_shard_events(
+            self, tmp_path, monkeypatch):
+        """Satellite of the JXA104-analog guard, sharded: a 2-virtual-
+        device CPU-mesh deferred window with full telemetry must issue
+        ZERO device->host transfers on the happy path while still
+        producing the schema-v2 ``exchange``/``shard_load`` events at
+        the flush. The pre-existing CPU-mesh drain
+        (Simulation._drain, a collective-serialization workaround that
+        real TPU meshes don't run) is the ONE sanctioned
+        block_until_ready — it is re-pointed at the real function so
+        everything else stays poisoned."""
+        import numpy as np
+
+        from sphexa_tpu.parallel.sizing import device_sparse_halo
+        from sphexa_tpu.sfc.box import make_global_box
+        from sphexa_tpu.sfc.keys import compute_sfc_keys
+
+        state, box, const = init_sedov(6)  # 216 / 2 devices (audit scale)
+        sink = JsonlSink(str(tmp_path / "events.jsonl"))
+        tel = Telemetry(sinks=[sink])
+        sim = Simulation(state, box, const, prop="std", block=512,
+                         backend="pallas", num_devices=2, check_every=3,
+                         telemetry=tel)
+        for _ in range(3):  # settle compiles on one full window
+            sim.step()
+
+        real_get = jax.device_get
+        real_block = jax.block_until_ready
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "device->host transfer on the sharded deferred happy path"
+            )
+
+        # sanction ONLY the drain's block (CPU-mesh artifact guard);
+        # any other block/get inside the window is instrumentation debt
+        drained = []
+
+        def drain_ok(out):
+            drained.append(1)
+            real_block([a for a in jax.tree.leaves(out)
+                        if hasattr(a, "block_until_ready")])
+            return out
+
+        monkeypatch.setattr(jax, "device_get", boom)
+        monkeypatch.setattr(jax, "block_until_ready", boom)
+        monkeypatch.setattr(sim, "_drain", drain_ok)
+        for _ in range(2):
+            d = sim.step()
+            assert d.get("deferred") == 1.0
+        monkeypatch.setattr(jax, "device_get", real_get)
+        monkeypatch.setattr(jax, "block_until_ready", real_block)
+        monkeypatch.undo()
+        sim.flush()
+        tel.close()
+        assert drained  # the sanctioned drain actually ran
+
+        events = [json.loads(l) for l in open(tmp_path / "events.jsonl")]
+        by_kind = lambda k: [e for e in events if e["kind"] == k]
+        loads = by_kind("shard_load")
+        exchanges = by_kind("exchange")
+        assert loads and exchanges
+        S = state.n // 2
+        assert loads[-1]["particles"] == [S, S]
+        assert len(exchanges[-1]["rows"]) == 2
+        assert exchanges[-1]["shipped_rows"] > 0
+        assert exchanges[-1]["mode"] in ("sparse", "windowed")
+        # independent size-based check (measure_multichip.py formulas):
+        # shipped rows == sum of the sized per-distance caps
+        gbox = make_global_box(state.x, state.y, state.z, box)
+        keys = compute_sfc_keys(state.x, state.y, state.z, gbox)
+        hc = device_sparse_halo(state.x, state.y, state.z, state.h, keys,
+                                gbox, sim._cfg.nbr, P=2,
+                                margin=sim._halo_margin)
+        assert exchanges[-1]["shipped_rows"] == sum(min(c, S) for c in hc)
+        mems = by_kind("memory")
+        assert {e["point"] for e in mems} >= {"post-compile", "flush"}
+        assert all(validate_event(e) == [] for e in events)
+
+    def test_imbalance_watchdog_fires_on_skewed_load(self):
+        """max/mean of a per-shard metric past the configured ratio is a
+        first-class ``imbalance`` event (+ counter), mirroring the
+        retrace watchdog — unit-level via a stub mesh so the watchdog
+        logic is pinned without a 90-second mesh run."""
+        from types import SimpleNamespace
+
+        sink = MemorySink()
+        sim = _sedov_sim(telemetry=Telemetry(sinks=[sink]))
+        sim._mesh = SimpleNamespace(size=2)
+        sim._halo_info = {"mode": "sparse", "shipped_rows": 128,
+                          "bytes_per_step": 128 * 18 * 4}
+        sim._emit_distributed(
+            {"shard_work": np.asarray([300.0, 100.0]),
+             "shard_rows": np.asarray([64, 64], np.int32),
+             "shard_occ": np.asarray([0.5, 0.5], np.float32),
+             "shard_trips": np.asarray([0, 0], np.int32)},
+            steps=1,
+        )
+        (imb,) = sink.of_kind("imbalance")
+        assert imb["metric"] == "work"
+        assert imb["ratio"] == pytest.approx(1.5)  # 300 / 200
+        assert imb["threshold"] == 1.5
+        assert sim.telemetry.counters["imbalances"] == 1
+        (ex,) = sink.of_kind("exchange")
+        assert ex["rows"] == [64, 64] and ex["shipped_rows"] == 128
+        (load,) = sink.of_kind("shard_load")
+        assert load["work"] == [300.0, 100.0]
+        # balanced load below the ratio stays silent
+        sim._emit_distributed(
+            {"shard_work": np.asarray([100.0, 100.0]),
+             "shard_rows": np.asarray([64, 64], np.int32),
+             "shard_occ": np.asarray([0.5, 0.5], np.float32),
+             "shard_trips": np.asarray([0, 0], np.int32)},
+            steps=1,
+        )
+        assert len(sink.of_kind("imbalance")) == 1
+
+    def test_memory_snapshot_shape_and_event(self):
+        from sphexa_tpu.telemetry import (
+            device_memory_snapshot,
+            emit_memory_event,
+        )
+
+        snap = device_memory_snapshot()
+        assert len(snap["devices"]) == len(jax.local_devices())
+        # CPU has no allocator stats: byte lists empty but PRESENT, so
+        # the mesh rehearsal validates the same schema the chip writes
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            assert isinstance(snap[k], list)
+        sink = MemorySink()
+        tel = Telemetry(sinks=[sink])
+        out = emit_memory_event(tel, "manifest")
+        assert out is not None
+        (e,) = sink.of_kind("memory")
+        assert e["point"] == "manifest"
+        assert validate_event(e) == []
+        # sink-less registry: snapshot skipped entirely (not worth the
+        # per-device stat calls for a counter bump)
+        assert emit_memory_event(Telemetry(), "manifest") is None
 
 
 # ---------------------------------------------------------------------------
@@ -339,6 +502,109 @@ class TestCli:
         bench.write_text(json.dumps({"metric": "m", "value": 5e5,
                                      "unit": "u"}))
         assert cli_main(["diff", str(bench), run]) == 1
+
+    def test_strict_reports_unknown_kind_counts(self, tmp_path, capsys):
+        """Forward compat: kinds this reader does not know are COUNTED
+        and reported (never silently dropped from the aggregation);
+        --strict turns them into exit 1 so CI notices version skew."""
+        run = _make_run(tmp_path, "a", [0.1])
+        with open(f"{run}/events.jsonl", "a") as f:
+            f.write(json.dumps({"v": SCHEMA_VERSION, "seq": 8, "t": 1.0,
+                                "kind": "from_the_future", "x": 1}) + "\n")
+            f.write(json.dumps({"v": SCHEMA_VERSION, "seq": 9, "t": 1.0,
+                                "kind": "from_the_future", "x": 2}) + "\n")
+        assert cli_main(["summary", run]) == 0  # lax: reported, not fatal
+        out = capsys.readouterr().out
+        assert "unknown kind: from_the_future x2" in out
+        assert cli_main(["summary", run, "--strict"]) == 1
+        capsys.readouterr()
+        assert cli_main(["summary", run, "--format", "json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["unknown_kinds"] == {"from_the_future": 2}
+
+    def test_v1_files_validate_under_v2_reader(self, tmp_path, capsys):
+        """The v1->v2 compatibility contract: a file written by the v1
+        schema (v1 envelope, v1 kinds) summarizes strictly clean under
+        this reader; a v2-only kind claiming v1 is flagged."""
+        d = tmp_path / "v1run"
+        d.mkdir()
+        with open(d / "events.jsonl", "w") as f:
+            f.write('{"v":1,"seq":0,"t":1.0,"kind":"step","it":1,'
+                    '"wall_s":0.1}\n')
+            f.write('{"v":1,"seq":1,"t":1.0,"kind":"retrace","it":1,'
+                    '"delta":1}\n')
+        assert cli_main(["summary", str(d), "--strict"]) == 0
+        capsys.readouterr()
+        with open(d / "events.jsonl", "a") as f:
+            f.write('{"v":1,"seq":2,"t":1.0,"kind":"exchange","it":2,'
+                    '"shipped_rows":1,"rows":[1]}\n')
+        assert cli_main(["summary", str(d), "--strict"]) == 1
+        assert "v2-only kind" in capsys.readouterr().out
+
+    def _make_shard_run(self, tmp_path):
+        d = tmp_path / "mesh"
+        t = Telemetry(sinks=[JsonlSink(str(d / "events.jsonl"))])
+        for it in (3, 6):
+            t.event("shard_load", it=it, steps=3,
+                    particles=[256, 256], work=[900.0 + it, 700.0])
+            t.event("exchange", it=it, steps=3, mode="sparse",
+                    shipped_rows=512, rows=[200 + it, 150],
+                    occ=[0.8, 0.6], bytes_per_step=512 * 18 * 4, trips=1)
+        t.event("memory", point="flush", it=6, devices=["0", "1"],
+                bytes_in_use=[1024, 2048], peak_bytes_in_use=[4096, 8192])
+        t.event("imbalance", it=6, metric="work", ratio=1.6,
+                threshold=1.5)
+        t.close()
+        write_manifest(str(d), particles=512, mesh_shape=(2,))
+        return str(d)
+
+    def test_shards_view_renders_and_aggregates(self, tmp_path, capsys):
+        run = self._make_shard_run(tmp_path)
+        assert cli_main(["shards", run]) == 0
+        out = capsys.readouterr().out
+        assert "halo rows" in out and "occ p95" in out
+        assert "sparse" in out and "escape trips" in out
+        assert "memory snapshots:" in out
+        assert cli_main(["shards", run, "--format", "json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert [sh["shard"] for sh in s["shards"]] == [0, 1]
+        assert s["shards"][0]["particles"] == 256
+        assert s["shards"][0]["work_share"] > s["shards"][1]["work_share"]
+        assert s["shipped_rows"] == 512 and s["mode"] == "sparse"
+        assert s["imbalance_events"] == 1 and s["trips"] == 1
+        assert s["memory"][0]["peak_bytes_in_use"] == [4096, 8192]
+
+    def test_shards_exit_1_without_shard_telemetry(self, tmp_path, capsys):
+        """The mesh smoke's assertion: a run with no per-shard events
+        must FAIL the shards view (exit 1), so check.sh catches a
+        silently un-instrumented mesh run."""
+        run = _make_run(tmp_path, "plain", [0.1])
+        assert cli_main(["shards", run]) == 1
+        assert "no per-shard telemetry" in capsys.readouterr().out
+
+    def test_diff_multichip_wrapper(self, tmp_path, capsys):
+        """MULTICHIP_r*.json wrapper diffing: the measure_multichip
+        --json line buried in a driver-wrapper tail compares with
+        threshold exit codes — comm-volume saving is higher-is-better."""
+        base = tmp_path / "MULTICHIP_base.json"
+        cand = tmp_path / "mc_cand.json"
+        line = {"metric": "sparse-halo saving vs replication", "value": 4.0,
+                "unit": "x", "extra": {"s16_p8_shipped_frac": 0.5,
+                                       "s16_p8_saving": 4.0}}
+        base.write_text(json.dumps(
+            {"n_devices": 8, "rc": 0, "ok": True,
+             "tail": "dryrun OK\n" + json.dumps(line)}))
+        cand.write_text(json.dumps(line))  # identical candidate
+        assert cli_main(["diff", str(base), str(cand)]) == 0
+        capsys.readouterr()
+        worse = dict(line, value=3.0,
+                     extra={"s16_p8_shipped_frac": 0.7,
+                            "s16_p8_saving": 3.0})
+        cand.write_text(json.dumps(worse))
+        # saving dropped 25%: beyond a 5% threshold -> regression exit 1
+        assert cli_main(["diff", str(base), str(cand),
+                         "--threshold", "0.05"]) == 1
+        assert "REGRESSED" in capsys.readouterr().out
 
     def test_app_writes_manifest_and_events(self, tmp_path):
         from sphexa_tpu.app.main import main as app_main
