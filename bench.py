@@ -155,6 +155,7 @@ def _gravity_scale_line(n=1_000_000):
 
 def main() -> int:
     from sphexa_tpu.init import init_evrard, init_sedov
+    from sphexa_tpu.observables import ObservableSpec
     from sphexa_tpu.simulation import Simulation
     from sphexa_tpu.telemetry import Telemetry
     from sphexa_tpu.telemetry.manifest import build_manifest
@@ -169,18 +170,29 @@ def main() -> int:
     # deferred cap-checking: the happy path issues no device->host sync
     # per step (diagnostics checked in one batch at the window end)
     sim = Simulation(state, box, const, prop="std", block=8192,
-                     check_every=STEPS, telemetry=tel)
+                     check_every=STEPS, telemetry=tel,
+                     obs_spec=ObservableSpec())
     std_ups = _measure(sim, n, STEPS)
     if std_ups is None:
         print("bench: no reconfigure-free window in 3 attempts", file=sys.stderr)
         return 1
 
     extra = {}
+    # conservation health of the benched run, free from the in-graph
+    # ledger (|etot - etot0| / |etot0| at the last flush): a perf win
+    # that leaks energy is not a win, so the bench line carries its own
+    # physics evidence next to the throughput number
+    if sim.energy_drift is not None:
+        import math
+
+        if math.isfinite(sim.energy_drift):
+            extra["std_energy_drift"] = float(f"{sim.energy_drift:.3e}")
     try:
         n_aux = AUX_SIDE**3
         state, box, const = init_sedov(AUX_SIDE)
         sim = Simulation(state, box, const, prop="ve", block=8192,
-                         check_every=AUX_STEPS, telemetry=tel)
+                         check_every=AUX_STEPS, telemetry=tel,
+                         obs_spec=ObservableSpec())
         ve_ups = _measure(sim, n_aux, AUX_STEPS)
         if ve_ups:
             extra["ve_updates_per_sec"] = round(ve_ups, 1)
@@ -191,7 +203,8 @@ def main() -> int:
     try:
         state, box, const = init_evrard(AUX_SIDE)
         sim = Simulation(state, box, const, prop="ve", block=8192,
-                         check_every=AUX_STEPS, telemetry=tel)
+                         check_every=AUX_STEPS, telemetry=tel,
+                         obs_spec=ObservableSpec())
         nev = int(state.n)
         veg_ups = _measure(sim, nev, AUX_STEPS)
         if veg_ups:
@@ -224,6 +237,10 @@ def main() -> int:
         # nonzero = the mesh run resized halos / tripped the watchdog
         "halo_trips": int(tel.counters.get("halo_trips", 0)),
         "imbalances": int(tel.counters.get("imbalances", 0)),
+        # physics health (schema v3): nonzero = a benched sim produced
+        # nonfinite rho/h/du (drift watchdog stays off here — benches
+        # run without a budget; the drift itself is std_energy_drift)
+        "field_health": int(tel.counters.get("field_health", 0)),
     }
 
     # measured breakdowns/commentary live in docs/NEXT.md, labeled with the

@@ -48,13 +48,25 @@ run_telemetry() {
         rm -rf "$dir"
         exit $rc
     fi
-    # --strict: every event must validate against the schema
+    # --strict: every event must validate against the schema (v3; v1/v2
+    # files keep validating via SUPPORTED_VERSIONS, pinned in tests)
     python -m sphexa_tpu.telemetry summary "$dir/run" --strict
     rc=$?
     if [ $rc -ne 0 ]; then
         rm -rf "$dir"
         echo "sphexa-telemetry summary failed (rc=$rc); schema drift or"
         echo "missing events — see docs/OBSERVABILITY.md."
+        exit $rc
+    fi
+    # science must RENDER the in-graph ledger (exit 1 = no physics
+    # events: the step-tail ledger or its fetch wiring broke)
+    python -m sphexa_tpu.telemetry science "$dir/run"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "sphexa-telemetry science failed (rc=$rc): no physics"
+        echo "telemetry or a watchdog fired — the conservation ledger"
+        echo "wiring broke (docs/OBSERVABILITY.md, schema v3)."
         exit $rc
     fi
 
@@ -79,6 +91,16 @@ run_telemetry() {
         rm -rf "$dir"
         echo "sphexa-telemetry shards failed (rc=$rc): the mesh run wrote"
         echo "no per-shard telemetry — exchange/shard_load wiring broke."
+        exit $rc
+    fi
+    # science on the DEFERRED mesh run: every step of the --check-every 5
+    # window must have kept its ledger row
+    python -m sphexa_tpu.telemetry science "$dir/mesh"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "sphexa-telemetry science failed on the mesh run (rc=$rc):"
+        echo "the deferred window lost its physics rows."
         exit $rc
     fi
     python -m sphexa_tpu.telemetry summary "$dir/mesh" --strict

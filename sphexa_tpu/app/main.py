@@ -104,6 +104,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="imbalance-watchdog threshold on max/mean of the "
                         "per-shard load/comm metrics (telemetry "
                         "'imbalance' events) [1.5]")
+    p.add_argument("--drift-budget", type=float, default=None,
+                   dest="drift_budget",
+                   help="conservation-drift watchdog: relative "
+                        "total-energy budget |etot-etot0|/|etot0| per "
+                        "check window (telemetry 'drift' events; "
+                        "default: report-only, no watchdog)")
     p.add_argument("--memory-profile", default=None, dest="memory_profile",
                    help="write a jax.profiler device-memory profile "
                         "(pprof) to this path at the end of the run")
@@ -143,8 +149,8 @@ def main(argv=None) -> int:
     from sphexa_tpu.init import make_initializer
     from sphexa_tpu.observables import (
         ConstantsWriter,
-        conserved_quantities,
         make_observable,
+        make_observable_spec,
     )
     from sphexa_tpu.simulation import _PROPAGATORS, Simulation
 
@@ -258,9 +264,13 @@ def main(argv=None) -> int:
         )
 
     # observable selected by the test case (observables/factory.hpp:46-70) —
-    # on restart, by the case name the snapshot recorded; field-consuming
-    # observables read rho/c straight from the step diagnostics
+    # on restart, by the case name the snapshot recorded. The observable
+    # object only names the constants.txt columns now: the values are
+    # computed IN-GRAPH by the step's science ledger (the matching
+    # ObservableSpec below), so no second reduction program and no
+    # per-step device sync remain — rows survive --check-every windows
     observable = make_observable(case_name, overrides=case_overrides)
+    obs_spec = make_observable_spec(case_name, overrides=case_overrides)
     if args.devices and args.devices > 1 and state.n % args.devices:
         # slab sharding needs a mesh-divisible count; trim the trailing
         # SFC rows (cases with non-cubic counts, e.g. sphere cuts, already
@@ -302,12 +312,14 @@ def main(argv=None) -> int:
                          av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
                          turb_state=turb_state, turb_cfg=turb_cfg,
                          chem=chem_restored, cooling_cfg=cooling_cfg,
-                         keep_fields=observable.needs_fields, theta=args.theta,
+                         theta=args.theta,
                          m2p_cap_margin=args.m2p_cap_margin,
                          num_devices=args.devices, halo_mode=args.halo_mode,
                          backend=args.backend,
                          check_every=args.check_every,
                          imbalance_ratio=args.imbalance_ratio,
+                         obs_spec=obs_spec, science_rows=True,
+                         drift_budget=args.drift_budget,
                          debug_checks=args.debug_checks, telemetry=telemetry)
     except (NotImplementedError, ValueError) as e:
         print(str(e), file=sys.stderr)
@@ -408,6 +420,21 @@ def main(argv=None) -> int:
         constants_path, observable,
         restart_iteration=restart_iteration if is_restart else None,
     )
+
+    def write_science_rows():
+        """Drain the verified in-graph ledger rows into constants.txt —
+        one row per step (deferred windows land whole at their flush
+        boundary, so --check-every N loses no science). The scalars were
+        fetched at the Simulation's existing check boundary: writing
+        them is pure host I/O, no device sync."""
+        rows = sim.drain_science()
+        for r in rows:
+            vals = [r["it"], r["t"], r["dt"], r["etot"], r["ecin"],
+                    r["eint"], r["egrav"]]
+            if "extra" in r:
+                vals.append(r["extra"])
+            constants.write_row(vals)
+        return rows
 
     def output_fields():
         from sphexa_tpu.analysis import compute_output_fields
@@ -546,15 +573,14 @@ def main(argv=None) -> int:
                         and time.time() - t0 >= args.duration:
                     log(f"# wall-clock limit {args.duration}s reached "
                         f"at iteration {it}")
+                    sim.flush()  # verify + land the window's rows
+                    write_science_rows()
                     if dump_path is not None \
                             and last_dump_iteration[0] != it:
-                        sim.flush()  # verify before the final dump
                         dump_now(it)
                     break
                 continue
-            e = conserved_quantities(sim.state, const, egrav=d.get("egrav", 0.0))
-            fields = {"rho": d["rho"], "c": d["c"]} if observable.needs_fields else None
-            row = constants.write(it, sim.state, sim.box, e, fields)
+            rows = write_science_rows()
             timer.step("observables")
             maybe_dump(it)  # dumps recompute the full derived set (r, p, u, ...)
             if insitu is not None:
@@ -567,14 +593,18 @@ def main(argv=None) -> int:
             if args.profile:
                 profile.record(it, laps, dt=float(d.get("dt", nan)),
                                nc_mean=float(d.get("nc_mean", nan)))
+            r = rows[-1] if rows else {}
             extra_cols = " ".join(
-                f"{n}={v:.4g}" for n, v in zip(observable.extra_columns, row[7:])
+                f"{n}={v:.4g}" for n, v in zip(
+                    observable.extra_columns,
+                    [r["extra"]] if "extra" in r else [])
             )
             log(
-                f"it {it:5d}  t={float(sim.state.ttot):.6g} "
+                f"it {it:5d}  t={r.get('t', nan):.6g} "
                 f"dt={float(d.get('dt', nan)):.4g} "
-                f"etot={float(e['etot']):.6f} ecin={float(e['ecin']):.4g} "
-                f"eint={float(e['eint']):.4g} "
+                f"etot={r.get('etot', nan):.6f} "
+                f"ecin={r.get('ecin', nan):.4g} "
+                f"eint={r.get('eint', nan):.4g} "
                 f"nc~{float(d.get('nc_mean', nan)):.0f}"
                 + (f" {extra_cols}" if extra_cols else "")
             )
@@ -594,10 +624,11 @@ def main(argv=None) -> int:
             _jax.profiler.stop_trace()
             log(f"# profiler trace -> {args.trace_dir}")
     # drain any open deferred window (--check-every > 1, -s not a
-    # multiple): the state must be verified before the final report and
-    # the telemetry window/flush events must land (Simulation.run's
-    # trailing flush, mirrored)
+    # multiple): the state must be verified before the final report, the
+    # telemetry window/flush events must land (Simulation.run's trailing
+    # flush, mirrored) and the window's constants.txt rows with them
     sim.flush()
+    write_science_rows()
     dt_wall = time.time() - t0
     n_done = sim.iteration - it0
     if args.profile:

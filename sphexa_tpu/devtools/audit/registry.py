@@ -237,6 +237,68 @@ def halo_exchange_sparse():
 
 
 # ---------------------------------------------------------------------------
+# in-graph observable ledger (observables/ledger.py) — the science
+# reductions every step tail runs; audited standalone so JXA101 (dtype)
+# and JXA104 (host boundary) hold the ledger itself, single-device and
+# over a 2-device mesh (where each sum lowers to a chained collective)
+# ---------------------------------------------------------------------------
+
+
+@entrypoint("observable_ledger")
+def observable_ledger():
+    import jax.numpy as jnp
+
+    from sphexa_tpu.observables.ledger import (
+        ObservableSpec,
+        ledger_diagnostics,
+    )
+
+    sim = _sim("sedov", _SIDE, prop="std")
+    s, box, const = sim.state, sim.box, sim.const
+    ngmax = sim._cfg.nbr.ngmax
+    spec = ObservableSpec(extra="mach")  # exercises the case-extra path
+    rho = jnp.ones_like(s.m)
+    c = jnp.ones_like(s.m)
+    nc = jnp.full((s.n,), const.ng0 - 1, jnp.int32)
+
+    def fn(state, b, rho, nc, c):
+        return ledger_diagnostics(state, rho, nc, const, ngmax, spec=spec,
+                                  egrav=0.0, box=b, c=c)
+
+    return EntryCase(fn=fn, args=(s, box, rho, nc, c))
+
+
+@entrypoint("observable_ledger_sharded", mesh_axes=("p",))
+def observable_ledger_sharded():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.observables.ledger import ledger_diagnostics
+    from sphexa_tpu.parallel import make_mesh, shard_state
+    from sphexa_tpu.simulation import make_propagator_config
+
+    if len(jax.devices()) < 2:
+        raise EntrySkip("needs >= 2 devices for the 'p' mesh "
+                        "(sphexa-audit bootstraps one; in-process callers "
+                        "use util.cpu_mesh.force_cpu_mesh)")
+    state, box, const = make_initializer("sedov")(_SIDE)
+    cfg = make_propagator_config(state, box, const)
+    mesh = make_mesh(2)
+    sstate = shard_state(state, mesh)
+    pspec = NamedSharding(mesh, PartitionSpec("p"))
+    rho = jax.device_put(jnp.ones((state.n,)), pspec)
+    nc = jax.device_put(jnp.full((state.n,), const.ng0 - 1, jnp.int32),
+                        pspec)
+
+    def fn(st, rho, nc):
+        return ledger_diagnostics(st, rho, nc, const, cfg.nbr.ngmax)
+
+    return EntryCase(fn=jax.jit(fn), args=(sstate, rho, nc))
+
+
+# ---------------------------------------------------------------------------
 # tree build / sizing (parallel/sizing.py)
 # ---------------------------------------------------------------------------
 

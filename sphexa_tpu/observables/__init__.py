@@ -18,6 +18,13 @@ from sphexa_tpu.observables.factory import (
     ConstantsWriter,
     make_observable,
 )
+from sphexa_tpu.observables.ledger import (
+    NUM_DIAG_KEYS,
+    OBS_DIAG_KEYS,
+    ObservableSpec,
+    ledger_diagnostics,
+    make_observable_spec,
+)
 
 __all__ = [
     "conserved_quantities",
@@ -26,6 +33,11 @@ __all__ = [
     "wind_bubble_fraction",
     "gravitational_wave_signal",
     "make_observable",
+    "make_observable_spec",
+    "ObservableSpec",
+    "ledger_diagnostics",
     "ConstantsWriter",
     "BASE_COLUMNS",
+    "OBS_DIAG_KEYS",
+    "NUM_DIAG_KEYS",
 ]

@@ -31,10 +31,17 @@ def kh_growth_rate(x, y, vy, vol, box) -> jnp.ndarray:
         jnp.exp(-4.0 * jnp.pi * jnp.abs(ybox - y - 0.25)),
     )
     w = vy * vol * aux
-    si = jnp.sum(w * jnp.sin(4.0 * jnp.pi * x))
-    ci = jnp.sum(w * jnp.cos(4.0 * jnp.pi * x))
-    di = jnp.sum(vol * aux)
-    return 2.0 * jnp.sqrt(si**2 + ci**2) / di
+    # ONE stacked reduction for the three sibling projections: inside
+    # the step program (observables/ledger.py) each independent sum
+    # would lower to its own collective under sharding, and mutually
+    # unordered collectives rendezvous-race on XLA:CPU meshes
+    # (parallel/exchange.chain_after)
+    s = jnp.sum(jnp.stack([
+        w * jnp.sin(4.0 * jnp.pi * x),
+        w * jnp.cos(4.0 * jnp.pi * x),
+        vol * aux,
+    ]), axis=1)
+    return 2.0 * jnp.sqrt(s[0]**2 + s[1]**2) / s[2]
 
 
 def mach_rms(vx, vy, vz, c) -> jnp.ndarray:

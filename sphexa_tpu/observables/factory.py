@@ -141,6 +141,14 @@ class ConstantsWriter:
             float(energies["eint"]), float(energies["egrav"]),
         ]
         row += self.observable.compute_extra(state, box, fields)
+        return self.write_row(row)
+
+    def write_row(self, values) -> List[float]:
+        """Append one pre-computed row (the in-graph ledger path: the
+        Simulation already fetched every scalar at its check/flush
+        boundary, so this touches no state and triggers no device
+        sync). Same header/format as ``write`` — byte-compatible."""
+        row = [float(v) for v in values]
         with open(self.path, "a") as f:
             if not self._wrote_header:
                 f.write("# " + " ".join(BASE_COLUMNS + self.observable.extra_columns) + "\n")

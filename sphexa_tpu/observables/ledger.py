@@ -1,0 +1,226 @@
+"""In-graph science ledger: conservation + numerics-health reductions
+computed INSIDE the jitted step.
+
+The reference computes its science observables in-situ every iteration —
+one reduction sweep per step (``conserved_quantities.hpp:40-179``) and
+one ``constants.txt`` row (``iobservables.hpp``). The app loop used to
+recompute them host-side per step (a second jitted reduction program
+over the same state, forcing a device sync per step and going blind
+inside deferred-check windows); this module moves the same sums into the
+step program so they ride the diagnostics dict (``OBS_DIAG_KEYS`` /
+``NUM_DIAG_KEYS``, the ``propagator.SHARD_DIAG_KEYS`` pattern) and are
+fetched in the ONE batched transfer at the existing check/flush
+boundary — zero added host syncs, a science row for every step even
+under ``--check-every N``.
+
+Collective ordering: under a sharded step each reduction lowers to an
+all-reduce, and mutually independent collectives rendezvous-race on this
+container's XLA:CPU meshes (the PR-5 sparse-exchange class; see
+``parallel/exchange.chain_after``). Every ledger reduction is therefore
+chained onto its predecessor's result — one total order, free on real
+TPU meshes where collectives execute in program order anyway.
+"""
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from sphexa_tpu.observables.conserved import _acc_dtype
+from sphexa_tpu.observables.extras import (
+    kh_growth_rate,
+    mach_rms,
+    wind_bubble_fraction,
+)
+
+#: conservation-ledger scalars the step tail emits whenever a
+#: PropagatorConfig.obs spec is set (the app/bench always set one; bare
+#: library steps skip the ledger, the SHARD_DIAG_KEYS conditionality
+#: pattern — consumers must .get()). Computed over the POST-integration
+#: state, matching the app's former eager recompute; ``obs_extra`` (the
+#: case observable) rides along only when the spec names an ``extra``.
+OBS_DIAG_KEYS = ("obs_ttot", "obs_etot", "obs_ecin", "obs_eint",
+                 "obs_egrav", "obs_linmom", "obs_angmom")
+
+#: numerics-health scalars riding the same ledger: timestep-limiter
+#: attribution (``propagator.DT_LIMITERS`` names the index), neighbor-cap
+#: clip and h-iteration saturation counts, nonfinite and extrema scalars
+#: for rho/h/du. ``dt_limiter`` is produced by the step builders (it
+#: needs the dt candidates) and is ALWAYS present — a 5-scalar argmin
+#: costs nothing; the O(N) counts/extrema ride the cfg.obs gate with
+#: the conservation scalars.
+NUM_DIAG_KEYS = ("dt_limiter", "n_nc_clip", "n_h_sat", "n_bad_rho",
+                 "n_bad_h", "n_bad_du", "rho_min", "h_min", "du_max")
+
+#: constants.txt column name per case-extra kind (matches the factory
+#: observables' ``extra_columns``)
+EXTRA_COLUMNS = {"kh": "khGrowthRate", "mach": "machRMS",
+                 "wind": "survivorFraction"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ObservableSpec:
+    """Static (hashable) selection of the case observable computed
+    in-graph — the PropagatorConfig-resident analog of the factory's
+    observable objects (``factory.hpp:46-70``). ``extra`` is one of
+    ``""`` (energies only), ``"kh"``, ``"mach"``, ``"wind"``; the
+    threshold fields are only read by the wind-bubble observable."""
+
+    extra: str = ""
+    rho_bubble: float = 0.0
+    temp_wind: float = 0.0
+    initial_mass: float = 1.0
+
+    def __post_init__(self):
+        if self.extra not in ("",) + tuple(EXTRA_COLUMNS):
+            raise ValueError(f"unknown observable extra {self.extra!r}; "
+                             f"choices: {sorted(EXTRA_COLUMNS)}")
+
+
+def make_observable_spec(case: str,
+                         overrides: Optional[Dict] = None) -> ObservableSpec:
+    """ObservableSpec for a test case, derived THROUGH the factory
+    observable (``factory.make_observable`` stays the single source of
+    truth for case keying, column names and thresholds). A factory
+    observable whose extra column has no in-graph implementation raises
+    loudly — a silent energies-only fallback would write a constants.txt
+    header with more columns than its rows carry."""
+    from sphexa_tpu.observables.factory import make_observable
+
+    obs = make_observable(case, overrides=overrides)
+    cols = obs.extra_columns
+    if not cols:
+        return ObservableSpec()
+    kinds = {col: kind for kind, col in EXTRA_COLUMNS.items()}
+    if len(cols) != 1 or cols[0] not in kinds:
+        raise ValueError(
+            f"case observable {type(obs).__name__} (columns {cols}) has "
+            f"no in-graph ledger implementation; add it to "
+            f"observables/ledger.py EXTRA_COLUMNS + ledger_diagnostics")
+    kind = kinds[cols[0]]
+    if kind == "wind":
+        return ObservableSpec(
+            extra="wind",
+            rho_bubble=float(obs.rho_bubble),
+            temp_wind=float(obs.temp_wind),
+            initial_mass=float(obs.initial_mass),
+        )
+    return ObservableSpec(extra=kind)
+
+
+def ledger_diagnostics(state, rho, nc, const, ngmax: int,
+                       spec: Optional[ObservableSpec] = None, egrav=0.0,
+                       box=None, c=None, smoothing: bool = True,
+                       token=None) -> Dict[str, jnp.ndarray]:
+    """The per-step science scalars (``OBS_DIAG_KEYS`` + the
+    ``NUM_DIAG_KEYS`` this function owns), as in-graph reductions over
+    the post-integration state.
+
+    ``rho``/``c`` are the force stage's density/sound speed in the
+    step's (sorted) order — the same pairing the app's eager recompute
+    used (post-step state + force-stage fields). ``nc`` is the neighbor
+    count EXCLUDING self, as the force stage returns it. ``smoothing``
+    mirrors ``update_smoothing``: propagators that never iterate h
+    (nbody) report zero cap/saturation counts instead of counting every
+    particle as off-target. ``token``: optional value produced by the
+    force stage's LAST collective (the shard-metrics gather on sharded
+    runs) — the ledger's first reduction chains on it so the two
+    families of collectives can never become concurrently runnable;
+    defaults to ``state.min_dt`` (= dt, which orders after the force
+    stage's pmin chain but not its gather).
+
+    The conservation sums are the exact math of
+    ``conserved.conserved_quantities`` (f64 accumulation when x64 is on,
+    XLA tree reduction in f32 otherwise; the two-sum carry ``temp_lo``
+    summed separately) so the in-graph constants.txt row equals the old
+    eager one.
+
+    The whole ledger lowers to THREE stacked reductions (one float sum
+    over a (9, N) stack, one int sum over (5, N), one min over (3, N)) —
+    the PR-5 ``_shard_metrics`` packing pattern: under sharding that is
+    three collectives instead of sixteen, which both bounds the SPMD
+    partitioner's compile cost across every step program in the suite
+    and shrinks the rendezvous-race surface the chaining guards.
+    """
+    from sphexa_tpu.parallel.exchange import chain_after
+
+    dt = _acc_dtype()
+    m = state.m
+    x, y, z = state.x, state.y, state.z
+    vx, vy, vz = state.vx, state.vy, state.vz
+
+    # one (9, N) float sweep: energies (two-sum carry separate) + the
+    # linear/angular momentum components
+    frows = jnp.stack([
+        m * (vx**2 + vy**2 + vz**2),
+        const.cv * state.temp * m,
+        const.cv * state.temp_lo * m,
+        m * vx, m * vy, m * vz,
+        m * (y * vz - z * vy),
+        m * (z * vx - x * vz),
+        m * (x * vy - y * vx),
+    ])
+    root = state.min_dt if token is None else token
+    fsum = jnp.sum(chain_after(frows, root), axis=1, dtype=dt)
+    ekin = 0.5 * fsum[0]
+    eint = fsum[1] + fsum[2]
+    egrav_s = jnp.asarray(egrav, dtype=ekin.dtype)
+    etot = ekin + eint + egrav_s
+
+    out = {
+        "obs_ttot": state.ttot,
+        "obs_etot": etot,
+        "obs_ecin": ekin,
+        "obs_eint": eint,
+        "obs_egrav": egrav_s,
+        "obs_linmom": jnp.sqrt(fsum[3]**2 + fsum[4]**2 + fsum[5]**2),
+        "obs_angmom": jnp.sqrt(fsum[6]**2 + fsum[7]**2 + fsum[8]**2),
+    }
+
+    # -- numerics health ---------------------------------------------------
+    # one (5, N) int sweep: cap-clip + saturation + nonfinite counts.
+    # h-iteration saturation: the single-nudge update_h targets ng0
+    # neighbors; a count off by more than half the target means the
+    # nudge is far from its fixed point (the reference's h iteration
+    # would not have converged) — resolution is locally wrong. nc
+    # excludes self, so counts use nc + 1 like the reference. Propagators
+    # that never iterate h (smoothing=False, nbody) report zeros.
+    nc1 = nc + 1
+    act = jnp.int32(1 if smoothing else 0)
+    irows = jnp.stack([
+        (nc1 >= ngmax).astype(jnp.int32) * act,
+        (jnp.abs(nc1 - const.ng0) > 0.5 * const.ng0).astype(jnp.int32)
+        * act,
+        (~jnp.isfinite(rho)).astype(jnp.int32),
+        (~jnp.isfinite(state.h)).astype(jnp.int32),
+        (~jnp.isfinite(state.du)).astype(jnp.int32),
+    ])
+    isum = jnp.sum(chain_after(irows, fsum[0]), axis=1)
+    out["n_nc_clip"] = isum[0]
+    out["n_h_sat"] = isum[1]
+    out["n_bad_rho"] = isum[2]
+    out["n_bad_h"] = isum[3]
+    out["n_bad_du"] = isum[4]
+
+    # one (3, N) min sweep: field extrema (max|du| = -min(-|du|))
+    mrows = jnp.stack([rho, state.h, -jnp.abs(state.du)])
+    mins = jnp.min(chain_after(mrows, isum[0]), axis=1)
+    out["rho_min"] = mins[0]
+    out["h_min"] = mins[1]
+    out["du_max"] = -mins[2]
+    tok = mins[0]
+
+    # -- case observable ---------------------------------------------------
+    if spec is not None and spec.extra:
+        if spec.extra == "kh":
+            vol = m / rho
+            out["obs_extra"] = kh_growth_rate(
+                state.x, state.y, chain_after(vy, tok), vol, box)
+        elif spec.extra == "mach":
+            cs = c if c is not None else jnp.full_like(rho, jnp.nan)
+            out["obs_extra"] = mach_rms(vx, vy, chain_after(vz, tok), cs)
+        else:  # wind
+            out["obs_extra"] = wind_bubble_fraction(
+                chain_after(rho, tok), state.temp, m, spec.rho_bubble,
+                spec.temp_wind, spec.initial_mass)
+    return out

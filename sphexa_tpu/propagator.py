@@ -23,6 +23,12 @@ from sphexa_tpu.gravity.ewald import EwaldConfig, compute_gravity_ewald
 from sphexa_tpu.gravity.traversal import GravityConfig, compute_gravity
 from sphexa_tpu.gravity.tree import GravityTree, GravityTreeMeta
 from sphexa_tpu.neighbors.cell_list import NeighborConfig, find_neighbors
+from sphexa_tpu.observables.ledger import (
+    NUM_DIAG_KEYS,
+    OBS_DIAG_KEYS,
+    ObservableSpec,
+    ledger_diagnostics,
+)
 from sphexa_tpu.sfc.box import Box, make_global_box
 from sphexa_tpu.sfc.keys import compute_sfc_keys
 from sphexa_tpu.sph import hydro_std, hydro_ve
@@ -63,6 +69,31 @@ STEP_DIAG_KEYS = ("dt", "nc_mean", "nc_max", "occupancy", "rho_max",
 #: (pinned by tests/test_telemetry.py). Present only on mesh runs through
 #: the pallas fast path; consumers must .get() them.
 SHARD_DIAG_KEYS = ("shard_rows", "shard_occ", "shard_work", "shard_trips")
+
+#: OBS_DIAG_KEYS / NUM_DIAG_KEYS (imported above) complete the diag-key
+#: families: the in-graph science ledger's conservation and
+#: numerics-health scalars (observables/ledger.py) ride the diagnostics
+#: dict and are fetched at the existing check/flush boundary exactly
+#: like SHARD_DIAG_KEYS — zero added host syncs under deferral.
+
+#: timestep-limiter attribution: ``diagnostics["dt_limiter"]`` indexes
+#: this tuple — WHICH candidate bound the step's dt (growth = the 1.1x
+#: previous-dt cap, then courant/rho/cool/accel as compute_timestep
+#: combines them, timestep.hpp:97-112). One global order across all
+#: propagators; inactive candidates rank as +inf.
+DT_LIMITERS = ("growth", "courant", "rho", "cool", "accel")
+
+
+def _dt_limiter(min_dt_prev, const: SimConstants, courant=None, rho=None,
+                cool=None, accel=None):
+    """Index into DT_LIMITERS of the binding dt candidate — the in-graph
+    attribution of ``compute_timestep``'s min-reduction (ties resolve to
+    the earlier name, matching jnp.argmin)."""
+    inf = jnp.asarray(jnp.inf, jnp.float32)
+    cands = [const.max_dt_increase * min_dt_prev, courant, rho, cool, accel]
+    stack = jnp.stack([inf if c is None else jnp.asarray(c, jnp.float32)
+                       for c in cands])
+    return jnp.argmin(stack).astype(jnp.int32)
 
 
 def shard_map(*args, **kwargs):
@@ -125,6 +156,9 @@ class PropagatorConfig:
     # global sort AND the candidate prologue, momentum ops lane-compact,
     # cheap ops chunk-skip. Sized at configure time like every cap.
     list_slot_cap: int = 0
+    # case observable computed in-graph alongside the conservation
+    # ledger (observables/ledger.py); None = energies only
+    obs: Optional[ObservableSpec] = None
     # Verlet skin as a fraction of the 2*h_max search radius: larger =
     # fewer rebuilds but more candidate lanes per target
     list_skin_rel: float = 0.2
@@ -285,16 +319,19 @@ def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
 
 
 def _integrate_and_finish(
-    state: ParticleState, box: Box, const: SimConstants,
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
     ax, ay, az, du, dt, nc, occ, rho, extra=None, extra_diag=None,
-    update_smoothing=True, keep_accels=False, keep_fields=False, c=None,
+    update_smoothing=True, c=None, dt_limiter=None,
 ):
     """Shared step tail: drift/kick + PBC wrap, smoothing-length nudge,
     state rebuild, diagnostics. Every propagator's force stage funnels
     through here (the analog of the common trailing sequence of
     std_hydro.hpp/ve_hydro.hpp step()); the diagnostics dict it builds
-    carries exactly the STEP_DIAG_KEYS scalars plus whatever extras the
-    caller rides along."""
+    carries exactly the STEP_DIAG_KEYS scalars, the in-graph science
+    ledger (OBS_DIAG_KEYS + NUM_DIAG_KEYS, observables/ledger.py — the
+    reference's per-iteration conserved_quantities sweep moved inside
+    the step program) plus whatever extras the caller rides along."""
+    const = cfg.const
     fields = (state.x, state.y, state.z, state.x_m1, state.y_m1, state.z_m1,
               state.vx, state.vy, state.vz, state.h, state.temp,
               state.temp_lo, du, state.du_m1)
@@ -321,9 +358,31 @@ def _integrate_and_finish(
         # (device->host round trips are expensive over remote links)
         "h_max": jnp.max(new_h),
     }
-    if keep_accels:
+    # conservation + numerics-health ledger over the post-integration
+    # state (the pairing the app's eager recompute used: new positions/
+    # velocities/temp with the force stage's rho/c); egrav is the force
+    # stage's value, like the reference adds it to etot in-sweep.
+    # Conditional like SHARD_DIAG_KEYS/keep_fields: cfg.obs = None skips
+    # it (bare library steps stay ledger-free and compile leaner); the
+    # app/bench always configure a spec, so every science-facing run
+    # carries the full ledger
+    if cfg.obs is not None:
+        ed = extra_diag or {}
+        diagnostics.update(ledger_diagnostics(
+            new_state, rho, nc, const, cfg.nbr.ngmax, spec=cfg.obs,
+            egrav=ed.get("egrav", 0.0), box=box, c=c,
+            smoothing=update_smoothing,
+            # sharded force stages chain their collectives and finish on
+            # the shard-metrics gather (SHARD_DIAG_KEYS) — anchor the
+            # ledger's reductions after it so the two collective families
+            # stay totally ordered (the XLA:CPU rendezvous guard)
+            token=ed.get("shard_trips"),
+        ))
+    if dt_limiter is not None:
+        diagnostics["dt_limiter"] = dt_limiter
+    if cfg.keep_accels:
         diagnostics.update({"ax": ax, "ay": ay, "az": az})
-    if keep_fields:
+    if cfg.keep_fields:
         diagnostics["rho"] = rho
         diagnostics["c"] = c if c is not None else jnp.zeros_like(rho)
     diagnostics.update(extra_diag or {})
@@ -691,9 +750,11 @@ def _step_hydro_std(
     (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
      gdiag, _) = _std_forces(state, box, cfg, gtree, lists=lists)
     dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=cfg.const)
+    limiter = _dt_limiter(state.min_dt, cfg.const, courant=dt_courant,
+                          accel=extra_dts[0] if extra_dts else None)
     return _integrate_and_finish(
-        state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
-        keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields, c=c,
+        state, box, cfg, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
+        c=c, dt_limiter=limiter,
     )
 
 
@@ -728,9 +789,12 @@ def _step_hydro_std_cooling(
 
     gdiag = {**(gdiag or {}), "dt_cool": dt_cool,
              "du_cool_min": jnp.min(du_cool)}
+    limiter = _dt_limiter(state.min_dt, const, courant=dt_courant,
+                          cool=dt_cool,
+                          accel=extra_dts[0] if extra_dts else None)
     new_state, box, diag = _integrate_and_finish(
-        state, box, const, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
-        keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields, c=c,
+        state, box, cfg, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
+        c=c, dt_limiter=limiter,
     )
     return new_state, box, diag, chem
 
@@ -868,6 +932,11 @@ def _ve_forces(
         gdiag = {**(gdiag or {}), **sdiag}
 
     dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts, const=const)
+    # limiter attribution rides gdiag into the step diagnostics (the ve
+    # builders hand gdiag to the shared tail as extra_diag)
+    gdiag = {**(gdiag or {}), "dt_limiter": _dt_limiter(
+        state.min_dt, const, courant=dt_courant, rho=dt_rho,
+        accel=extra_dts[0] if extra_dts else None)}
     return state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag
 
 
@@ -886,9 +955,8 @@ def _step_hydro_ve(
         state, box, cfg, gtree, lists=lists
     )
     return _integrate_and_finish(
-        state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho,
-        extra={"alpha": alpha}, extra_diag=gdiag, keep_accels=cfg.keep_accels,
-        keep_fields=cfg.keep_fields, c=c,
+        state, box, cfg, ax, ay, az, du, dt, nc, occ, rho,
+        extra={"alpha": alpha}, extra_diag=gdiag, c=c,
     )
 
 
@@ -908,9 +976,8 @@ def _step_turb_ve(
         state.x, state.y, state.z, ax, ay, az, dt, turb, turb_cfg
     )
     new_state, box, diag = _integrate_and_finish(
-        state, box, cfg.const, ax, ay, az, du, dt, nc, occ, rho,
-        extra={"alpha": alpha}, extra_diag=gdiag, keep_accels=cfg.keep_accels,
-        keep_fields=cfg.keep_fields, c=c,
+        state, box, cfg, ax, ay, az, du, dt, nc, occ, rho,
+        extra={"alpha": alpha}, extra_diag=gdiag, c=c,
     )
     return new_state, box, diag, turb
 
@@ -933,12 +1000,13 @@ def _step_nbody(
         state, box, keys, cfg, gtree, zero, zero, zero
     )
     dt = compute_timestep(state.min_dt, dt_acc, const=const)
+    limiter = _dt_limiter(state.min_dt, const, accel=dt_acc)
 
     nc = jnp.zeros_like(state.x, dtype=jnp.int32)
     return _integrate_and_finish(
-        state, box, const, ax, ay, az, zero, dt, nc, jnp.int32(0), zero,
+        state, box, cfg, ax, ay, az, zero, dt, nc, jnp.int32(0), zero,
         extra_diag={**gdiag, "egrav": egrav}, update_smoothing=False,
-        keep_accels=cfg.keep_accels, keep_fields=cfg.keep_fields,
+        dt_limiter=limiter,
     )
 
 

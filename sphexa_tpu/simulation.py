@@ -81,6 +81,7 @@ def make_propagator_config(
     list_skin_rel: float = 0.2,
     list_slot_margin: float = 1.3,
     sizing_cache=None,
+    obs_spec=None,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -206,7 +207,7 @@ def make_propagator_config(
     return PropagatorConfig(
         const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean,
         keep_accels=keep_accels, keep_fields=keep_fields, backend=backend,
-        list_slot_cap=slot_cap, list_skin_rel=list_skin_rel,
+        list_slot_cap=slot_cap, list_skin_rel=list_skin_rel, obs=obs_spec,
     )
 
 
@@ -262,6 +263,9 @@ class Simulation:
         debug_checks: bool = False,
         telemetry: Optional[Telemetry] = None,
         imbalance_ratio: float = 1.5,
+        obs_spec=None,
+        drift_budget: Optional[float] = None,
+        science_rows: bool = False,
     ):
         # telemetry registry: every driver-visible control-flow event
         # (reconfigure/rollback/replay/retrace) and step timing reports
@@ -284,6 +288,25 @@ class Simulation:
         # stamped by _configure_sharded for the exchange events
         self._halo_info: Optional[Dict] = None
         self._mem_post_compile = False  # one "post-compile" HBM snapshot
+        # physics observability (schema v3): the in-graph science ledger
+        # (propagator OBS/NUM_DIAG_KEYS) is fetched with the step
+        # diagnostics at the existing check/flush boundaries and emitted
+        # as physics/numerics events. Two watchdogs mirror the imbalance
+        # one: conservation drift (|etot - etot0| / |etot0| past
+        # ``drift_budget``; None = report-only) and field health (any
+        # nonfinite rho/h/du — the pointer to --debug-checks for
+        # localization).
+        self._obs_spec = obs_spec
+        self._drift_budget = (None if drift_budget is None
+                              else float(drift_budget))
+        self._etot0: Optional[float] = None
+        #: |Δetot|/|etot0| at the last fetch boundary (bench stamps it)
+        self.energy_drift: Optional[float] = None
+        # per-step science rows (constants.txt material) accumulated at
+        # verified boundaries for drain_science(); opt-in so library
+        # drivers that never drain don't grow an unbounded list
+        self._collect_science = bool(science_rows)
+        self._science: list = []
         self.state = state
         self.box = box
         self.const = const
@@ -510,6 +533,7 @@ class Simulation:
             list_skin_rel=self._list_skin_rel,
             list_slot_margin=self._slot_margin,
             sizing_cache=sizing_cache,
+            obs_spec=self._obs_spec,
         )
         if self.gravity_on:
             self._configure_gravity(grav_margin, keys_cache=sizing_cache)
@@ -1004,6 +1028,125 @@ class Simulation:
         emit_memory_event(self.telemetry, point, devices=devices,
                           it=self.iteration)
 
+    def drain_science(self) -> list:
+        """Per-step science rows (constants.txt material: it, t, dt,
+        energies, momenta, the case extra) accumulated since the last
+        drain — one dict per VERIFIED step, in iteration order, built
+        from the already-fetched ledger scalars (no device access).
+        Rows appear only at check/flush boundaries, so under deferral a
+        whole window's rows land at once; rolled-back windows never
+        produce rows (their replay does). Requires
+        ``Simulation(science_rows=True)``."""
+        rows, self._science = self._science, []
+        return rows
+
+    def _emit_science(self, fetched, its) -> None:
+        """Schema-v3 physics observability at the fetch boundary: one
+        ``physics`` + one ``numerics`` event per checked step / clean
+        window (per-step parallel lists, like the v2 shard events), the
+        science rows for drain_science(), and the two watchdogs.
+        ``fetched`` holds the already-FETCHED per-step diagnostics —
+        host arithmetic only, the deferred-window zero-sync contract is
+        untouched (pinned by tests/test_telemetry.py)."""
+        from sphexa_tpu.propagator import DT_LIMITERS
+
+        steps = [(it, d) for it, d in zip(its, fetched)
+                 if "obs_etot" in d]
+        if not steps:
+            return
+        tel = self.telemetry
+        rows = []
+        for it, d in steps:
+            row = {"it": int(it), "t": float(d["obs_ttot"]),
+                   "dt": float(d["dt"]), "etot": float(d["obs_etot"]),
+                   "ecin": float(d["obs_ecin"]),
+                   "eint": float(d["obs_eint"]),
+                   "egrav": float(d["obs_egrav"]),
+                   "linmom": float(d["obs_linmom"]),
+                   "angmom": float(d["obs_angmom"])}
+            if "obs_extra" in d:
+                row["extra"] = float(d["obs_extra"])
+            rows.append(row)
+        if self._collect_science:
+            self._science.extend(rows)
+        if self._etot0 is None and np.isfinite(rows[0]["etot"]):
+            self._etot0 = rows[0]["etot"]
+        payload = {k: [r[k] for r in rows]
+                   for k in ("dt", "etot", "ecin", "eint", "egrav",
+                             "linmom", "angmom")}
+        # simulated time travels as t_sim: the envelope already owns "t"
+        # (epoch seconds), and a payload key must never shadow it
+        payload["t_sim"] = [r["t"] for r in rows]
+        if all("extra" in r for r in rows):
+            payload["extra"] = [r["extra"] for r in rows]
+        tel.event("physics", it=rows[-1]["it"], steps=len(rows),
+                  its=[r["it"] for r in rows], **payload)
+
+        # numerics: limiter histogram + window-aggregate health scalars
+        lim: Dict[str, int] = {}
+        bad = {"rho": 0, "h": 0, "du": 0}
+        first_bad = None
+        for it, d in steps:
+            if "dt_limiter" in d:
+                name = DT_LIMITERS[int(d["dt_limiter"])]
+                lim[name] = lim.get(name, 0) + 1
+            step_bad = {f: int(d.get(f"n_bad_{f}", 0)) for f in bad}
+            for f in bad:
+                bad[f] = max(bad[f], step_bad[f])
+            if first_bad is None and sum(step_bad.values()) > 0:
+                first_bad = (it, step_bad)
+        ds = [d for _, d in steps]
+
+        def ext(key, fn):
+            # aggregate over the window's FINITE samples only: Python
+            # min/max NaN-propagation is order-dependent (a NaN would be
+            # sticky or masked depending on which step produced it) —
+            # corruption is reported by the nonfinite counts/field_health
+            # event, the extrema stay deterministic
+            arr = np.asarray([float(d.get(key, np.nan)) for d in ds])
+            finite = arr[np.isfinite(arr)]
+            return float(fn(finite)) if finite.size else float("nan")
+
+        agg = {
+            "nc_clip": max(int(d.get("n_nc_clip", 0)) for d in ds),
+            "h_sat": max(int(d.get("n_h_sat", 0)) for d in ds),
+            "rho_min": ext("rho_min", np.min),
+            "rho_max": ext("rho_max", np.max),
+            "h_min": ext("h_min", np.min),
+            "h_max": ext("h_max", np.max),
+            "du_max": ext("du_max", np.max),
+        }
+        tel.event("numerics", it=rows[-1]["it"], steps=len(rows),
+                  limiter=lim, nonfinite=bad, **agg)
+
+        # conservation-drift watchdog: relative total-energy excursion
+        # vs the run's first verified step, evaluated over EVERY step of
+        # the window (a mid-window spike that relaxes by the flush must
+        # still fire — the offline science --budget gate checks the full
+        # series, the runtime watchdog must agree); energy_drift exposes
+        # the latest verified value (the bench stamp)
+        if self._etot0 is not None:
+            denom = abs(self._etot0) or 1.0
+            drifts = [abs(r["etot"] - self._etot0) / denom for r in rows]
+            self.energy_drift = drifts[-1]
+            worst = max(range(len(rows)), key=lambda i: (
+                drifts[i] if np.isfinite(drifts[i]) else -1.0))
+            if (self._drift_budget is not None
+                    and drifts[worst] > self._drift_budget):
+                tel.count("drifts")
+                tel.event("drift", it=rows[worst]["it"],
+                          drift=drifts[worst],
+                          budget=self._drift_budget, etot0=self._etot0,
+                          etot=rows[worst]["etot"])
+        # field-health watchdog: any nonfinite rho/h/du is a first-class
+        # event naming the first bad step; --debug-checks localizes it
+        if first_bad is not None:
+            it_bad, step_bad = first_bad
+            tel.count("field_health")
+            tel.event("field_health", it=it_bad,
+                      nonfinite=sum(step_bad.values()), fields=step_bad,
+                      hint="re-run with --debug-checks to localize")
+
     @staticmethod
     def _lists_fresh(diagnostics) -> bool:
         """False when the step ran on EXPIRED lists (drift/growth ate
@@ -1087,6 +1230,7 @@ class Simulation:
             reconfigured=bool(reconfigured),
         )
         self._emit_distributed(diagnostics, steps=1)
+        self._emit_science([diagnostics], [self.iteration])
         self._emit_memory("post-compile")
         if self.debug_checks:
             # first triggered checkify predicate of THIS step ("" = all
@@ -1169,6 +1313,14 @@ class Simulation:
             # distributed telemetry rides the SAME fetch: per-shard
             # load/exchange events + HBM snapshot, at window granularity
             self._emit_distributed(fetched[-1], steps=len(pending))
+            # science ledger rides it too: one physics/numerics event +
+            # a constants row per step of the window (every step keeps
+            # its row even under --check-every N)
+            self._emit_science(
+                fetched,
+                list(range(self.iteration - len(pending) + 1,
+                           self.iteration + 1)),
+            )
             self._emit_memory("post-compile")
             self._emit_memory("flush")
             diagnostics = {**pending[-1], **fetched[-1]}

@@ -2,7 +2,8 @@
 
     sphexa-telemetry summary <run-dir> [--format text|json] [--strict]
     sphexa-telemetry shards  <run-dir> [--format text|json]
-    sphexa-telemetry diff <baseline> <candidate> [--threshold F]
+    sphexa-telemetry science <run-dir> [--format text|json] [--budget F]
+    sphexa-telemetry diff <baseline> <candidate> [--threshold F] [--drift]
 
 ``summary`` reads ``<run-dir>/manifest.json`` + ``events.jsonl`` and
 reports p50/p95/mean step time, retrace/rollback/reconfigure counts and
@@ -18,6 +19,15 @@ per-device HBM snapshots. Exit 1 when the run carries no per-shard
 telemetry (so a mesh-rehearsal smoke can assert the instrumentation
 actually fired).
 
+``science`` is the physics view (schema-v3 ``physics`` / ``numerics`` /
+``drift`` / ``field_health`` events from the in-graph ledger): the
+conservation-drift table and rate, the timestep-limiter histogram, the
+field-extrema timeline, nonfinite counts, and watchdog hits. Exit 1
+when the run carries no physics telemetry, when ``--budget`` is given
+and the run's max |Δetot|/|etot0| exceeds it, or (without ``--budget``)
+when a drift/field-health watchdog fired during the run — so CI can
+gate on conservation the way it already gates on step time.
+
 ``diff`` compares two run directories, two bench JSONs (``bench.py``
 output, the ``BENCH_r*.json`` driver wrapper, or the
 ``MULTICHIP_r*.json`` wrapper whose tail carries
@@ -25,7 +35,9 @@ output, the ``BENCH_r*.json`` driver wrapper, or the
 bench baseline (throughput derived as particles / p50 step time). Exit
 codes are CI-shaped: 0 within threshold, 1 regression beyond it, 2
 usage/unreadable input — so a pipeline can gate on step-time or
-comm-volume regressions directly.
+comm-volume regressions directly. ``--drift`` makes run-vs-run energy
+drift a headline metric (drift-vs-drift with the same threshold exit
+codes).
 
 Deliberately jax-free: summarizing a run must not drag in a backend.
 """
@@ -136,11 +148,15 @@ def summarize_run(run_dir: str) -> Dict:
         "windows": len(_of_kind(events, "window")),
         "launches": len(_of_kind(events, "launch")),
         "step_time": step_time,
-        "retraces": int(sum(e.get("delta", 1)
-                            for e in _of_kind(events, "retrace"))),
+        # partial/corrupt records (a killed run's half-written events)
+        # degrade to defaults instead of TypeError-ing the aggregation
+        "retraces": int(sum(
+            e["delta"] if isinstance(e.get("delta"), (int, float)) else 1
+            for e in _of_kind(events, "retrace"))),
         "rollbacks": len(_of_kind(events, "rollback")),
-        "replayed_steps": int(sum(e.get("steps", 0)
-                                  for e in _of_kind(events, "replay"))),
+        "replayed_steps": int(sum(
+            e["steps"] if isinstance(e.get("steps"), (int, float)) else 0
+            for e in _of_kind(events, "replay"))),
         # construction-time sizing is expected once per run, not a
         # mid-run health signal — only non-initial rebuilds count
         "reconfigures": len([e for e in _of_kind(events, "reconfigure")
@@ -242,6 +258,89 @@ def summarize_shards(run_dir: str) -> Dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# science view (schema v3 physics-observability events)
+# ---------------------------------------------------------------------------
+
+
+def _concat_series(events: List[dict], key: str):
+    """Flatten one per-step list field across physics/numerics events
+    into a single python list (older/malformed events that carry a bare
+    scalar contribute that scalar once; non-numeric entries drop)."""
+    out: List[float] = []
+    for e in events:
+        v = e.get(key)
+        if not isinstance(v, list):
+            v = [v]
+        out.extend(float(x) for x in v
+                   if isinstance(x, (int, float)))
+    return out
+
+
+def summarize_science(run_dir: str) -> Dict:
+    """Aggregate one run's physics-observability (schema v3) events:
+    the per-step conservation series and its drift, the dt-limiter
+    histogram, nonfinite counts, field extrema, watchdog hits. Partial
+    records (crash before the first flush: no physics events at all)
+    summarize to an empty-but-rendered view, never a traceback."""
+    events, problems = load_events(run_dir)
+    phys = _of_kind(events, "physics")
+    nums = _of_kind(events, "numerics")
+
+    its = [int(x) for x in _concat_series(phys, "its")]
+    series = {k: _concat_series(phys, k)
+              for k in ("t_sim", "dt", "etot", "ecin", "eint", "egrav",
+                        "linmom", "angmom")}
+    etot = np.asarray(series["etot"], dtype=np.float64)
+    t_sim = np.asarray(series["t_sim"], dtype=np.float64)
+
+    drift = {}
+    finite = etot[np.isfinite(etot)]
+    if finite.size:
+        e0 = float(finite[0])
+        denom = abs(e0) or 1.0
+        with np.errstate(invalid="ignore"):
+            d = np.abs(etot - e0) / denom
+        dmax = float(np.nanmax(d)) if np.isfinite(d).any() else None
+        dfin = float(d[-1]) if np.isfinite(d[-1]) else None
+        drift = {"etot0": e0, "etot_final": float(etot[-1]),
+                 "max": dmax, "final": dfin}
+        if (dfin is not None and t_sim.size == etot.size
+                and t_sim.size > 1 and t_sim[-1] > t_sim[0]):
+            drift["per_time"] = dfin / float(t_sim[-1] - t_sim[0])
+
+    limiter: Dict[str, int] = {}
+    nonfinite: Dict[str, int] = {}
+    extrema_rows: List[Dict] = []
+    for e in nums:
+        for name, n in (e.get("limiter") or {}).items():
+            if isinstance(n, int):
+                limiter[str(name)] = limiter.get(str(name), 0) + n
+        for f, n in (e.get("nonfinite") or {}).items():
+            if isinstance(n, int):
+                nonfinite[str(f)] = max(nonfinite.get(str(f), 0), n)
+        extrema_rows.append({
+            k: e.get(k) for k in ("it", "rho_min", "rho_max", "h_min",
+                                  "h_max", "du_max", "nc_clip", "h_sat")
+        })
+
+    return {
+        "run_dir": run_dir,
+        "manifest": read_manifest(run_dir),
+        "physics_events": len(phys),
+        "steps": len(its) or len(series["etot"]),
+        "t_range": [float(t_sim[0]), float(t_sim[-1])] if t_sim.size
+        else None,
+        "drift": drift,
+        "limiter": dict(sorted(limiter.items())),
+        "nonfinite": nonfinite,
+        "extrema": extrema_rows,
+        "drift_events": len(_of_kind(events, "drift")),
+        "field_health_events": len(_of_kind(events, "field_health")),
+        "schema_problems": problems,
+    }
+
+
 def _parse_bench_json(path: str) -> Dict:
     """bench.py's JSON line, or a driver wrapper (``BENCH_r*.json`` /
     ``MULTICHIP_r*.json``) whose ``tail`` buries a metric/value line in
@@ -290,10 +389,16 @@ def _run_updates_per_sec(side: Dict) -> Optional[float]:
     return float(n) / float(p50)
 
 
-def diff_sides(base: Dict, cand: Dict, threshold: float) -> Dict:
+def diff_sides(base: Dict, cand: Dict, threshold: float,
+               drift: bool = False) -> Dict:
     """Compare candidate against baseline. Returns the comparison dict;
-    ``regressed`` is True when the headline metric moved past the
-    threshold in the bad direction (step time up / throughput down)."""
+    ``regressed`` is True when a headline metric moved past the
+    threshold in the bad direction (step time up / throughput down /
+    energy drift up). ``drift`` promotes run-vs-run energy drift to a
+    headline metric (drift-vs-drift, the conservation regression gate)
+    and errors when either side lacks physics telemetry."""
+    if drift and not (base["type"] == "run" and cand["type"] == "run"):
+        raise TelemetryError("--drift compares two run directories")
     rows: List[Dict] = []
 
     def row(metric, a, b, higher_is_better, headline=False):
@@ -327,6 +432,30 @@ def diff_sides(base: Dict, cand: Dict, threshold: float) -> Dict:
         for k in sorted(set(a["phase_mean_s"]) & set(b["phase_mean_s"])):
             row(f"phase_{k}_mean_s", a["phase_mean_s"][k],
                 b["phase_mean_s"][k], higher_is_better=False)
+        # conservation: drift-vs-drift, computed ONLY under --drift —
+        # each science view re-parses events.jsonl, and a plain
+        # step-time diff (incl. of pre-v3 runs) must not pay that or
+        # change behavior
+        if drift:
+            da = summarize_science(base["label"]).get("drift", {}).get(
+                "max")
+            db = summarize_science(cand["label"]).get("drift", {}).get(
+                "max")
+            if da is None or db is None:
+                raise TelemetryError(
+                    "--drift needs physics telemetry on both sides "
+                    "(re-run with --telemetry-dir on a v3 writer)")
+            # drift is legitimately EXACTLY zero on short baselines; a
+            # ratio-only gate would turn any nonzero candidate into an
+            # infinite regression — floor the baseline at 1e-9 (f32
+            # noise scale) before the relative comparison
+            base_eff = max(da, 1e-9)
+            rows.append({
+                "metric": "energy_drift_max", "baseline": da,
+                "candidate": db, "change": db / base_eff - 1.0,
+                "headline": True,
+                "regressed": bool(db > base_eff * (1.0 + threshold)),
+            })
     elif base["type"] == "bench" and cand["type"] == "bench":
         a, b = base["bench"], cand["bench"]
         # the headline is whatever the bench line's metric is: throughput
@@ -490,6 +619,72 @@ def render_shards(s: Dict) -> str:
     return "\n".join(lines)
 
 
+def _fmt_g(v, fmt="{:.6g}") -> str:
+    return "-" if v is None else fmt.format(v)
+
+
+def render_science(s: Dict) -> str:
+    m = s.get("manifest") or {}
+    lines = [f"run: {s['run_dir']}"]
+    if m:
+        lines.append(
+            f"  backend {m.get('backend', '?')}"
+            + (f"  N={m['particles']}" if m.get("particles") else "")
+            + (f"  case {m['case']}" if m.get("case") else "")
+        )
+    if not s["physics_events"]:
+        lines.append("  no physics telemetry in this run "
+                     "(pre-v3 writer, or it crashed before the first "
+                     "check/flush boundary)")
+        return "\n".join(lines)
+    d = s.get("drift") or {}
+    rows = [
+        ("steps", s["steps"]),
+        ("t range", "-" if not s.get("t_range") else
+         f"{s['t_range'][0]:.6g} .. {s['t_range'][1]:.6g}"),
+        ("etot first", _fmt_g(d.get("etot0", None), "{:.10g}")),
+        ("etot final", _fmt_g(d.get("etot_final", None), "{:.10g}")),
+        ("|drift| final", _fmt_g(d.get("final"), "{:.3e}")),
+        ("|drift| max", _fmt_g(d.get("max"), "{:.3e}")),
+    ]
+    if d.get("per_time") is not None:
+        rows.append(("drift rate (/sim-time)", f"{d['per_time']:.3e}"))
+    rows.append(("drift watchdog events", s["drift_events"]))
+    rows.append(("field-health events", s["field_health_events"]))
+    for f, n in sorted((s.get("nonfinite") or {}).items()):
+        if n:
+            rows.append((f"nonfinite {f} (max/step)", n))
+    lines.append(render_table(rows))
+    if s.get("limiter"):
+        total = sum(s["limiter"].values()) or 1
+        lines.append("timestep limiter:")
+        lines.append(render_table(
+            [(name, n, f"{n / total:.1%}")
+             for name, n in sorted(s["limiter"].items(),
+                                   key=lambda kv: -kv[1])],
+            headers=("limiter", "steps", "share")))
+    ext = [r for r in s.get("extrema", []) if r.get("it") is not None]
+    if ext:
+        lines.append("extrema timeline (per checked step / window):")
+        show = ext if len(ext) <= 12 else ext[:3] + ext[-9:]
+        rows = [(r["it"], _fmt_g(r.get("rho_min"), "{:.4g}"),
+                 _fmt_g(r.get("rho_max"), "{:.4g}"),
+                 _fmt_g(r.get("h_min"), "{:.4g}"),
+                 _fmt_g(r.get("h_max"), "{:.4g}"),
+                 _fmt_g(r.get("du_max"), "{:.4g}"),
+                 _fmt_g(r.get("nc_clip"), "{}"),
+                 _fmt_g(r.get("h_sat"), "{}"))
+                for r in show]
+        lines.append(render_table(
+            rows, headers=("it", "rho min", "rho max", "h min", "h max",
+                           "|du| max", "nc clip", "h sat")))
+        if len(ext) > 12:
+            lines.append(f"  ({len(ext) - 12} middle windows elided)")
+    for p in s["schema_problems"]:
+        lines.append(f"  schema: {p}")
+    return "\n".join(lines)
+
+
 def render_diff(d: Dict) -> str:
     lines = [f"baseline:  {d['baseline']}",
              f"candidate: {d['candidate']}",
@@ -529,11 +724,24 @@ def build_parser() -> argparse.ArgumentParser:
         "shards", help="per-shard load/comm/HBM view of a multi-chip run")
     ph.add_argument("run_dir")
     ph.add_argument("--format", choices=("text", "json"), default="text")
+    pc = sub.add_parser(
+        "science",
+        help="conservation/numerics view of a run (drift table + rate, "
+             "dt-limiter histogram, extrema timeline, watchdog hits)")
+    pc.add_argument("run_dir")
+    pc.add_argument("--format", choices=("text", "json"), default="text")
+    pc.add_argument("--budget", type=float, default=None,
+                    help="exit 1 if max |etot-etot0|/|etot0| exceeds "
+                         "this relative budget; without it, exit 1 when "
+                         "a drift/field-health watchdog fired in-run")
     pd = sub.add_parser("diff", help="diff candidate against baseline")
     pd.add_argument("baseline", help="run dir or bench JSON")
     pd.add_argument("candidate", help="run dir or bench JSON")
     pd.add_argument("--threshold", type=float, default=0.10,
                     help="relative headline-regression threshold [0.10]")
+    pd.add_argument("--drift", action="store_true",
+                    help="run-vs-run: make energy drift a headline "
+                         "metric (conservation regression gate)")
     pd.add_argument("--format", choices=("text", "json"), default="text")
     return p
 
@@ -554,8 +762,19 @@ def main(argv=None) -> int:
             # a mesh smoke asserting the instrumentation fired needs a
             # distinct exit code for "run exists but no shard telemetry"
             return 0 if s["shards"] else 1
+        if args.cmd == "science":
+            s = summarize_science(args.run_dir)
+            print(json.dumps(s, indent=2) if args.format == "json"
+                  else render_science(s))
+            if not s["physics_events"]:
+                return 1  # no ledger: the smoke must notice broken wiring
+            if args.budget is not None:
+                dmax = (s.get("drift") or {}).get("max")
+                return 1 if dmax is None or dmax > args.budget else 0
+            return 1 if (s["drift_events"]
+                         or s["field_health_events"]) else 0
         d = diff_sides(load_side(args.baseline), load_side(args.candidate),
-                       args.threshold)
+                       args.threshold, drift=args.drift)
         print(json.dumps(d, indent=2) if args.format == "json"
               else render_diff(d))
         return 1 if d["regressed"] else 0

@@ -48,8 +48,14 @@ def build_manifest(config: Optional[Dict] = None,
         device_count = jax.device_count()
     except Exception:  # manifest must never sink the run it describes
         jax_version, backend, device_count = "unknown", "unknown", 0
+    from sphexa_tpu.telemetry.registry import SCHEMA_VERSION
+
     return {
         "schema": MANIFEST_SCHEMA,
+        # the event-stream schema this run's writer speaks (events.jsonl
+        # carries it per event too; stamped here so readers can tell a
+        # pre-v3 run without scanning the stream)
+        "events_schema": SCHEMA_VERSION,
         "created": datetime.datetime.now(datetime.timezone.utc).isoformat(),
         "git_rev": git_rev(),
         "jax_version": jax_version,

@@ -21,13 +21,15 @@ import numpy as np
 
 #: events.jsonl schema version; bump on any incompatible field change and
 #: document the migration in docs/OBSERVABILITY.md. v2 added the
-#: distributed kinds (exchange / shard_load / memory / imbalance) and
-#: changed nothing about the v1 kinds, so v2 readers accept v1 files.
-SCHEMA_VERSION = 2
+#: distributed kinds (exchange / shard_load / memory / imbalance), v3
+#: the physics-observability kinds (physics / numerics / drift /
+#: field_health); neither changed the older kinds, so v3 readers accept
+#: v1 and v2 files.
+SCHEMA_VERSION = 3
 
 #: event schema versions this reader understands (older versions only
 #: ever ADD kinds, so the per-kind field table below covers them all)
-SUPPORTED_VERSIONS = (1, 2)
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 #: every event kind the schema admits, with its required payload fields
 #: (beyond the envelope ``v``/``seq``/``t``/``kind``). The CLI's --strict
@@ -58,12 +60,34 @@ EVENT_KINDS: Dict[str, tuple] = {
     # imbalance watchdog: max/mean of a per-shard metric crossed the
     # configured ratio (the runtime analog of the retrace watchdog)
     "imbalance": ("it", "metric", "ratio", "threshold"),
+    # -- v3: physics-observability kinds (the in-graph science ledger) ----
+    # per-window conservation record: parallel per-step lists (``its``,
+    # ``t``, ``dt``, ``etot``/``ecin``/``eint``/``egrav``, ``linmom``,
+    # ``angmom``, optional ``extra``) — every step keeps its row even
+    # under deferred checking
+    "physics": ("it", "etot"),
+    # per-window numerics health: dt-limiter histogram, neighbor-cap
+    # clip / h-saturation counts, nonfinite counts, field extrema
+    "numerics": ("it",),
+    # conservation-drift watchdog: |etot - etot0|/|etot0| crossed the
+    # configured budget (Simulation(drift_budget=...) / --drift-budget)
+    "drift": ("it", "drift", "budget"),
+    # field-health watchdog: nonfinite rho/h/du values appeared in a
+    # verified step (localize with --debug-checks)
+    "field_health": ("it", "nonfinite"),
 }
 
-#: kinds that already existed in schema v1 (a v1 event carrying a
-#: v2-only kind is writer confusion, not forward compatibility)
-V1_KINDS = frozenset(EVENT_KINDS) - {
-    "exchange", "shard_load", "memory", "imbalance"}
+#: first schema version each kind appeared in (an older-versioned event
+#: carrying a newer kind is writer confusion, not forward compatibility)
+_V2_ONLY = frozenset({"exchange", "shard_load", "memory", "imbalance"})
+_V3_ONLY = frozenset({"physics", "numerics", "drift", "field_health"})
+KIND_SINCE: Dict[str, int] = {
+    k: 3 if k in _V3_ONLY else 2 if k in _V2_ONLY else 1
+    for k in EVENT_KINDS
+}
+
+#: kinds that already existed in schema v1 (kept for introspection)
+V1_KINDS = frozenset(k for k, v in KIND_SINCE.items() if v == 1)
 
 
 def _jsonable(v):
@@ -80,13 +104,13 @@ def _jsonable(v):
 
 def validate_event(e: dict) -> List[str]:
     """Schema problems with one event dict ([] = valid). Any supported
-    version validates (v2 readers accept v1 files). An UNKNOWN kind is
-    deliberately NOT a problem here — unknownness is the forward-compat
-    dimension the reader reports separately (summary's
+    version validates (v3 readers accept v1/v2 files). An UNKNOWN kind
+    is deliberately NOT a problem here — unknownness is the
+    forward-compat dimension the reader reports separately (summary's
     ``unknown_kinds`` counts, strict exit code), and flagging it twice
     would render every future-schema event as schema-invalid noise. A
-    v2-only kind claiming ``v: 1`` IS a problem (writer confusion, not
-    forward compat)."""
+    newer-only kind claiming an older ``v`` IS a problem (writer
+    confusion, not forward compat)."""
     problems = []
     if not isinstance(e, dict):
         return ["event is not an object"]
@@ -94,8 +118,10 @@ def validate_event(e: dict) -> List[str]:
         problems.append(f"bad schema version {e.get('v')!r}")
     kind = e.get("kind")
     if kind in EVENT_KINDS:
-        if e.get("v") == 1 and kind not in V1_KINDS:
-            problems.append(f"v2-only kind {kind!r} on a v1 event")
+        since = KIND_SINCE[kind]
+        if e.get("v") in SUPPORTED_VERSIONS and e["v"] < since:
+            problems.append(
+                f"v{since}-only kind {kind!r} on a v{e['v']} event")
         else:
             for field in EVENT_KINDS[kind]:
                 if field not in e:

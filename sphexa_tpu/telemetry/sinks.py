@@ -57,7 +57,7 @@ class JsonlSink:
 #: console reader actually wants to see; per-step launch/phases spam is
 #: left to the JSONL record)
 _NOTABLE = ("reconfigure", "rollback", "replay", "retrace", "trace",
-            "imbalance")
+            "imbalance", "drift", "field_health")
 
 
 class ConsoleSink:

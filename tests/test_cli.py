@@ -73,6 +73,42 @@ class TestEndToEnd:
 
         assert list_steps(f"{out}/dump_sedov.h5") == [0]
 
+    def test_deferred_run_keeps_every_constants_row(self, tmp_path):
+        """ISSUE-8 acceptance: a --check-every 8 deferred Sedov run
+        writes a constants.txt row for EVERY step, matching the synced
+        run's columns to reduction-order tolerance (the in-graph ledger
+        fetched at the flush boundary — the old eager path skipped rows
+        inside deferred windows entirely)."""
+        sync, deferred = str(tmp_path / "sync"), str(tmp_path / "def")
+        assert run_cli("--init", "sedov", "-n", "6", "-s", "8",
+                       "-o", sync, "--quiet") == 0
+        assert run_cli("--init", "sedov", "-n", "6", "-s", "8",
+                       "--check-every", "8", "-o", deferred,
+                       "--quiet") == 0
+        a = np.loadtxt(f"{sync}/constants.txt")
+        b = np.loadtxt(f"{deferred}/constants.txt")
+        assert a.shape == b.shape == (8, 7)
+        assert list(b[:, 0]) == list(range(1, 9))  # every iteration
+        np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-12)
+
+    def test_drift_budget_flag_emits_watchdog_events(self, tmp_path):
+        out = str(tmp_path / "out")
+        tdir = str(tmp_path / "tel")
+        # a negative budget trips on ANY drift including zero — proves
+        # the flag reaches the watchdog without depending on how many
+        # ulps a 4-step Sedov wiggles; exit stays 0 (watchdogs report,
+        # they don't abort the run)
+        assert run_cli("--init", "sedov", "-n", "6", "-s", "4",
+                       "--drift-budget=-1.0", "-o", out,
+                       "--telemetry-dir", tdir, "--quiet") == 0
+        import json
+
+        events = [json.loads(l) for l in open(f"{tdir}/events.jsonl")]
+        assert any(e["kind"] == "drift" for e in events)
+        from sphexa_tpu.telemetry.cli import main as tcli
+
+        assert tcli(["science", tdir]) == 1  # watchdog fired in-run
+
     def test_g_override_enables_gravity(self, tmp_path):
         out = str(tmp_path)
         # noh is open-boundary, g=0 by default; --G turns gravity on

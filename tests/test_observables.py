@@ -1,20 +1,26 @@
 """Observable tests: KH growth rate, Mach RMS, wind-bubble fraction,
-gravitational waves, constants.txt writer. Mirrors
+gravitational waves, constants.txt writer, and the in-graph science
+ledger (observables/ledger.py — the step-resident mirror of
+conserved_quantities that rides the diagnostics dict). Mirrors
 main/test/observables/gravitational_waves.cpp plus hand-checkable
 constructions for the reductions.
 """
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
 from sphexa_tpu.observables import (
     ConstantsWriter,
+    ObservableSpec,
     conserved_quantities,
     gravitational_wave_signal,
     kh_growth_rate,
+    ledger_diagnostics,
     mach_rms,
     make_observable,
+    make_observable_spec,
     wind_bubble_fraction,
 )
 from sphexa_tpu.observables.extras import GW_UNITS
@@ -129,6 +135,135 @@ class TestGravWaves:
         assert np.isfinite(float(hp)) and np.isfinite(float(hc))
 
 
+class TestLedger:
+    """The in-graph science ledger: same sums as the eager
+    conserved_quantities, riding the step diagnostics (OBS_DIAG_KEYS /
+    NUM_DIAG_KEYS) so deferred windows keep every step's row."""
+
+    def test_step_diag_carries_ledger_keys(self):
+        from sphexa_tpu.init import init_sedov
+        from sphexa_tpu.propagator import NUM_DIAG_KEYS, OBS_DIAG_KEYS
+        from sphexa_tpu.simulation import Simulation
+
+        state, box, const = init_sedov(6)
+        sim = Simulation(state, box, const, prop="std", block=512,
+                         obs_spec=ObservableSpec())
+        d = sim.step()
+        assert set(OBS_DIAG_KEYS) <= set(d)
+        assert set(NUM_DIAG_KEYS) <= set(d)
+
+    def test_ledger_matches_eager_conserved(self):
+        """The diag ledger of a real step equals the app's former eager
+        recompute over the post-step state — the constants.txt column
+        contract."""
+        from sphexa_tpu.init import init_sedov
+        from sphexa_tpu.simulation import Simulation
+
+        state, box, const = init_sedov(6)
+        sim = Simulation(state, box, const, prop="std", block=512,
+                         obs_spec=ObservableSpec())
+        d = sim.step()
+        e = conserved_quantities(sim.state, const,
+                                 egrav=d.get("egrav", 0.0))
+        for k in ("etot", "ecin", "eint", "egrav", "linmom", "angmom"):
+            assert float(d[f"obs_{k}"]) == pytest.approx(
+                float(e[k]), rel=1e-6, abs=1e-30), k
+        assert float(d["obs_ttot"]) == pytest.approx(
+            float(sim.state.ttot), rel=1e-7)
+
+    def test_ledger_sharded_matches_single_device(self):
+        """2-device GSPMD reductions equal single-device values to
+        reduction-order tolerance — the ledger's sharding contract (the
+        chained collectives must not corrupt the sums, the PR-5 XLA:CPU
+        rendezvous class)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from sphexa_tpu.init import init_sedov
+        from sphexa_tpu.parallel import make_mesh, shard_state
+
+        state, box, const = init_sedov(6)
+        rho = jnp.abs(state.x) + 0.5
+        nc = (jnp.arange(state.n) % 120).astype(jnp.int32)
+
+        fn = jax.jit(lambda st, r, n: ledger_diagnostics(
+            st, r, n, const, 150))
+        single = jax.device_get(fn(state, rho, nc))
+
+        mesh = make_mesh(2)
+        pspec = NamedSharding(mesh, PartitionSpec("p"))
+        sstate = shard_state(state, mesh)
+        srho = jax.device_put(rho, pspec)
+        snc = jax.device_put(nc, pspec)
+        sharded = jax.device_get(fn(sstate, srho, snc))
+
+        assert set(single) == set(sharded)
+        for k in single:
+            np.testing.assert_allclose(
+                sharded[k], single[k], rtol=1e-6, atol=1e-12,
+                err_msg=k)
+
+    def test_numerics_counts_hand_checked(self):
+        """Clip/saturation/nonfinite counts on a doctored state."""
+        from sphexa_tpu.init import init_sedov
+
+        state, box, const = init_sedov(4)
+        n = state.n
+        nc = jnp.full((n,), const.ng0 - 1, jnp.int32)  # on target
+        nc = nc.at[0].set(200)   # >= ngmax: clipped AND saturated
+        nc = nc.at[1].set(3)     # far below ng0: saturated
+        import dataclasses
+
+        h = np.asarray(state.h).copy()
+        h[2] = np.nan
+        state = dataclasses.replace(state, h=jnp.asarray(h))
+        rho = jnp.ones((n,))
+        d = ledger_diagnostics(state, rho, nc, const, ngmax=150)
+        assert int(d["n_nc_clip"]) == 1
+        assert int(d["n_h_sat"]) == 2
+        assert int(d["n_bad_h"]) == 1
+        assert int(d["n_bad_rho"]) == 0
+        assert float(d["rho_min"]) == 1.0
+
+    def test_dt_limiter_attribution(self):
+        from sphexa_tpu.propagator import DT_LIMITERS, _dt_limiter
+        from sphexa_tpu.sph.particles import SimConstants
+
+        const = SimConstants()
+        prev = jnp.float32(1.0)  # growth cap = 1.1
+        lim = lambda **kw: DT_LIMITERS[int(_dt_limiter(prev, const, **kw))]
+        assert lim(courant=2.0) == "growth"
+        assert lim(courant=0.5) == "courant"
+        assert lim(courant=0.5, rho=0.2) == "rho"
+        assert lim(courant=0.5, rho=0.2, cool=0.1) == "cool"
+        assert lim(courant=0.5, accel=0.01) == "accel"
+
+    def test_make_observable_spec_matches_factory(self):
+        assert make_observable_spec("sedov") == ObservableSpec()
+        assert make_observable_spec("kelvin-helmholtz").extra == "kh"
+        assert make_observable_spec("turbulence").extra == "mach"
+        wind = make_observable_spec("wind-shock")
+        ref = make_observable("wind-shock")
+        assert wind.extra == "wind"
+        assert wind.rho_bubble == pytest.approx(ref.rho_bubble)
+        assert wind.temp_wind == pytest.approx(ref.temp_wind)
+        assert wind.initial_mass == pytest.approx(ref.initial_mass)
+        with pytest.raises(ValueError):
+            ObservableSpec(extra="bogus")
+
+    def test_ledger_extra_wind_matches_reduction(self):
+        from sphexa_tpu.init import init_sedov
+
+        state, box, const = init_sedov(4)
+        rho = jnp.abs(state.x) + 0.5
+        spec = ObservableSpec(extra="wind", rho_bubble=1.0,
+                              temp_wind=2.0, initial_mass=3.0)
+        nc = jnp.zeros((state.n,), jnp.int32)
+        d = ledger_diagnostics(state, rho, nc, const, 150, spec=spec,
+                               box=box)
+        ref = wind_bubble_fraction(rho, state.temp, state.m, 1.0, 2.0, 3.0)
+        assert float(d["obs_extra"]) == pytest.approx(float(ref), rel=1e-6)
+
+
 class TestFactoryAndWriter:
     def test_factory_selection(self):
         assert isinstance(make_observable("sedov"), TimeAndEnergy)
@@ -151,3 +286,15 @@ class TestFactoryAndWriter:
         row = [float(v) for v in lines[1].split()]
         assert row[0] == 1.0
         assert row[3] == pytest.approx(float(e["etot"]), rel=1e-6)
+
+    def test_write_row_byte_compatible_with_write(self, tmp_path):
+        """The ledger path (write_row on pre-fetched scalars) must
+        produce the identical bytes the state-reading write() did."""
+        from sphexa_tpu.init import init_sedov
+
+        state, box, const = init_sedov(4)
+        e = conserved_quantities(state, const)
+        a, b = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+        row = ConstantsWriter(a).write(3, state, box, e)
+        ConstantsWriter(b).write_row(row)
+        assert open(a).read() == open(b).read()

@@ -144,13 +144,18 @@ class TestSimulationTelemetry:
 
     def test_deferred_happy_path_is_sync_free(self, tmp_path, monkeypatch):
         """The JXA104-analog runtime guard: with telemetry fully enabled
-        (JSONL sink + registry), deferred-window steps must not issue ANY
+        (JSONL sink + registry) AND the in-graph observables on (a case
+        extra + science rows), deferred-window steps must not issue ANY
         device->host transfer — jax.device_get / block_until_ready are
         poisoned for the whole happy-path window and only restored for
         the flush, which is where the one batched fetch belongs."""
+        from sphexa_tpu.observables import ObservableSpec
+
         sink = JsonlSink(str(tmp_path / "events.jsonl"))
         tel = Telemetry(sinks=[sink])
-        sim = _sedov_sim(side=12, telemetry=tel, check_every=4)
+        sim = _sedov_sim(side=12, telemetry=tel, check_every=4,
+                         obs_spec=ObservableSpec(extra="mach"),
+                         science_rows=True, drift_budget=1e3)
         # settle compiles + config on a first full window
         for _ in range(4):
             sim.step()
@@ -182,6 +187,21 @@ class TestSimulationTelemetry:
         assert windows[-1]["steps"] == 3
         assert windows[-1]["per_step_s"] > 0
         assert "rollback" not in kinds
+        # the science ledger rode the same fetch: one physics + one
+        # numerics event per window, every step's row preserved
+        phys = [e for e in events if e["kind"] == "physics"]
+        assert [e["steps"] for e in phys] == [4, 3]
+        assert phys[-1]["its"] == [5, 6, 7]
+        assert all(np.isfinite(v) for e in phys for v in e["etot"])
+        assert all(len(e["extra"]) == e["steps"] for e in phys)  # machRMS
+        nums = [e for e in events if e["kind"] == "numerics"]
+        assert len(nums) == 2 and sum(nums[-1]["limiter"].values()) == 3
+        assert "drift" not in kinds and "field_health" not in kinds
+        rows = sim.drain_science()
+        assert [r["it"] for r in rows] == list(range(1, 8))
+        assert all(np.isfinite(r["etot"]) and "extra" in r for r in rows)
+        assert sim.drain_science() == []  # drained
+        assert sim.energy_drift is not None and sim.energy_drift < 1e-3
 
     def test_rollback_retrace_replay_events(self):
         """A deferred-detected overflow must surface as first-class
@@ -197,8 +217,12 @@ class TestSimulationTelemetry:
         watchdog would correctly report nothing."""
         state, box, const = init_sedov(14)
         sink = MemorySink()
+        from sphexa_tpu.observables import ObservableSpec
+
         sim = Simulation(state, box, const, prop="std", block=4096,
-                         check_every=3, telemetry=Telemetry(sinks=[sink]))
+                         check_every=3, science_rows=True,
+                         obs_spec=ObservableSpec(),
+                         telemetry=Telemetry(sinks=[sink]))
         sim._cfg = dataclasses.replace(
             sim._cfg, nbr=dataclasses.replace(sim._cfg.nbr, cap=8)
         )
@@ -218,6 +242,12 @@ class TestSimulationTelemetry:
         assert sim.telemetry.counters["rollbacks"] == 1
         assert sim.telemetry.counters["retraces"] >= 1
         assert sink.of_kind("retrace")
+        # science rows: the rolled-back window wrote NONE of its rows —
+        # only the replay's verified steps did, so the constants.txt
+        # series stays monotone and complete
+        rows = sim.drain_science()
+        assert [r["it"] for r in rows] == [1, 2, 3]
+        assert len(sink.of_kind("physics")) == 3  # one per replayed step
 
     def test_run_line_survives_missing_diag_keys(self):
         """Simulation.run's report uses .get() + nan for propagator-
@@ -265,9 +295,11 @@ class TestDistributedTelemetry:
         state, box, const = init_sedov(6)  # 216 / 2 devices (audit scale)
         sink = JsonlSink(str(tmp_path / "events.jsonl"))
         tel = Telemetry(sinks=[sink])
+        from sphexa_tpu.observables import ObservableSpec
+
         sim = Simulation(state, box, const, prop="std", block=512,
                          backend="pallas", num_devices=2, check_every=3,
-                         telemetry=tel)
+                         obs_spec=ObservableSpec(), telemetry=tel)
         for _ in range(3):  # settle compiles on one full window
             sim.step()
 
@@ -322,6 +354,11 @@ class TestDistributedTelemetry:
         assert exchanges[-1]["shipped_rows"] == sum(min(c, S) for c in hc)
         mems = by_kind("memory")
         assert {e["point"] for e in mems} >= {"post-compile", "flush"}
+        # the science ledger rode the same sharded fetch: its sums
+        # lowered to the chained collectives, values stayed finite
+        phys = by_kind("physics")
+        assert [e["steps"] for e in phys] == [3, 2]
+        assert all(np.isfinite(v) for e in phys for v in e["etot"])
         assert all(validate_event(e) == [] for e in events)
 
     def test_imbalance_watchdog_fires_on_skewed_load(self):
@@ -385,6 +422,97 @@ class TestDistributedTelemetry:
         # per-device stat calls for a counter bump)
         assert emit_memory_event(Telemetry(), "manifest") is None
 
+
+# ---------------------------------------------------------------------------
+# physics observability (schema v3): ledger events, drift + field-health
+# watchdogs
+# ---------------------------------------------------------------------------
+
+
+class TestScienceTelemetry:
+    def test_drift_watchdog_fires_on_energy_leak(self):
+        """A seeded energy leak (internal energy doubled mid-run) must
+        cross the configured drift budget and surface as a first-class
+        ``drift`` event + counter — the conservation contract of long
+        unattended runs (Keller et al. 2023)."""
+        from sphexa_tpu.observables import ObservableSpec
+
+        sink = MemorySink()
+        sim = _sedov_sim(telemetry=Telemetry(sinks=[sink]),
+                         drift_budget=0.05, obs_spec=ObservableSpec())
+        sim.step()  # establishes etot0
+        assert sink.of_kind("drift") == []
+        sim.state = dataclasses.replace(sim.state,
+                                        temp=sim.state.temp * 2.0)
+        sim.step()
+        events = sink.of_kind("drift")
+        assert events and events[-1]["drift"] > 0.05
+        assert events[-1]["budget"] == 0.05
+        assert sim.telemetry.counters["drifts"] >= 1
+        assert sim.energy_drift > 0.05
+        from sphexa_tpu.telemetry.registry import validate_event
+
+        assert all(validate_event(e) == [] for e in sink.events)
+
+    def test_drift_watchdog_fires_on_mid_window_excursion(self):
+        """A transient leak that relaxes before the flush must still
+        fire: the watchdog gates on the WINDOW MAX drift, matching the
+        offline science --budget gate over the full series (unit-level
+        via doctored fetched diagnostics, like the imbalance test)."""
+        def diag(it, etot):
+            return {"obs_ttot": it * 1e-3, "dt": 1e-3, "obs_etot": etot,
+                    "obs_ecin": 0.0, "obs_eint": etot, "obs_egrav": 0.0,
+                    "obs_linmom": 0.0, "obs_angmom": 0.0}
+
+        sink = MemorySink()
+        sim = _sedov_sim(telemetry=Telemetry(sinks=[sink]),
+                         drift_budget=0.1)
+        # spike at step 2, fully relaxed by the window's last step
+        sim._emit_science([diag(1, 1.0), diag(2, 1.5), diag(3, 1.0)],
+                          [1, 2, 3])
+        (ev,) = sink.of_kind("drift")
+        assert ev["it"] == 2 and ev["drift"] == pytest.approx(0.5)
+        assert sim.energy_drift == pytest.approx(0.0)  # latest verified
+
+    def test_drift_watchdog_silent_without_budget(self):
+        """Default is report-only: no budget, no drift events — but the
+        drift itself is still tracked for bench/CLI consumers."""
+        from sphexa_tpu.observables import ObservableSpec
+
+        sink = MemorySink()
+        sim = _sedov_sim(telemetry=Telemetry(sinks=[sink]),
+                         obs_spec=ObservableSpec())
+        sim.step()
+        sim.state = dataclasses.replace(sim.state,
+                                        temp=sim.state.temp * 2.0)
+        sim.step()
+        assert sink.of_kind("drift") == []
+        assert sim.energy_drift > 0.05
+
+    def test_field_health_watchdog_fires_on_seeded_nan(self):
+        """A seeded NaN velocity must poison du in the next step and
+        surface as a ``field_health`` event naming the bad field —
+        with the pointer at --debug-checks for localization."""
+        import numpy as np
+
+        from sphexa_tpu.observables import ObservableSpec
+
+        sink = MemorySink()
+        sim = _sedov_sim(telemetry=Telemetry(sinks=[sink]),
+                         obs_spec=ObservableSpec())
+        sim.step()
+        assert sink.of_kind("field_health") == []
+        vx = np.asarray(sim.state.vx).copy()
+        vx[0] = np.nan
+        import jax.numpy as jnp
+
+        sim.state = dataclasses.replace(sim.state, vx=jnp.asarray(vx))
+        d = sim.step()
+        assert int(d["n_bad_du"]) > 0
+        (ev,) = sink.of_kind("field_health")
+        assert ev["nonfinite"] > 0 and ev["fields"]["du"] > 0
+        assert "--debug-checks" in ev["hint"]
+        assert sim.telemetry.counters["field_health"] == 1
 
 # ---------------------------------------------------------------------------
 # CLI
@@ -522,10 +650,11 @@ class TestCli:
         s = json.loads(capsys.readouterr().out)
         assert s["unknown_kinds"] == {"from_the_future": 2}
 
-    def test_v1_files_validate_under_v2_reader(self, tmp_path, capsys):
-        """The v1->v2 compatibility contract: a file written by the v1
-        schema (v1 envelope, v1 kinds) summarizes strictly clean under
-        this reader; a v2-only kind claiming v1 is flagged."""
+    def test_v1_v2_files_validate_under_v3_reader(self, tmp_path, capsys):
+        """The version-compat contract: files written by the v1 and v2
+        schemas (older envelopes, their own kinds) summarize strictly
+        clean under this v3 reader; a newer-only kind claiming an older
+        version is flagged."""
         d = tmp_path / "v1run"
         d.mkdir()
         with open(d / "events.jsonl", "w") as f:
@@ -533,13 +662,21 @@ class TestCli:
                     '"wall_s":0.1}\n')
             f.write('{"v":1,"seq":1,"t":1.0,"kind":"retrace","it":1,'
                     '"delta":1}\n')
+            # v2 envelope with a v2 kind: valid under the v3 reader
+            f.write('{"v":2,"seq":2,"t":1.0,"kind":"exchange","it":1,'
+                    '"shipped_rows":1,"rows":[1]}\n')
         assert cli_main(["summary", str(d), "--strict"]) == 0
         capsys.readouterr()
         with open(d / "events.jsonl", "a") as f:
-            f.write('{"v":1,"seq":2,"t":1.0,"kind":"exchange","it":2,'
+            f.write('{"v":1,"seq":3,"t":1.0,"kind":"exchange","it":2,'
                     '"shipped_rows":1,"rows":[1]}\n')
         assert cli_main(["summary", str(d), "--strict"]) == 1
         assert "v2-only kind" in capsys.readouterr().out
+        with open(d / "events.jsonl", "a") as f:
+            f.write('{"v":2,"seq":4,"t":1.0,"kind":"physics","it":3,'
+                    '"etot":[1.0]}\n')
+        assert cli_main(["summary", str(d), "--strict"]) == 1
+        assert "v3-only kind" in capsys.readouterr().out
 
     def _make_shard_run(self, tmp_path):
         d = tmp_path / "mesh"
@@ -606,6 +743,106 @@ class TestCli:
                          "--threshold", "0.05"]) == 1
         assert "REGRESSED" in capsys.readouterr().out
 
+    def _make_science_run(self, tmp_path, name, etots, nan_steps=0,
+                          watchdogs=()):
+        d = tmp_path / name
+        t = Telemetry(sinks=[JsonlSink(str(d / "events.jsonl"))])
+        n = len(etots)
+        t.event("physics", it=n, steps=n, its=list(range(1, n + 1)),
+                t_sim=[0.001 * i for i in range(1, n + 1)],
+                dt=[0.001] * n, etot=etots, ecin=[0.0] * n,
+                eint=etots, egrav=[0.0] * n, linmom=[0.0] * n,
+                angmom=[0.0] * n)
+        t.event("numerics", it=n, steps=n,
+                limiter={"courant": n - 1, "growth": 1},
+                nonfinite={"rho": 0, "h": 0, "du": nan_steps},
+                nc_clip=0, h_sat=2, rho_min=0.9, rho_max=1.5,
+                h_min=0.1, h_max=0.2, du_max=0.3)
+        for kind in watchdogs:
+            if kind == "drift":
+                t.event("drift", it=n, drift=0.5, budget=0.1,
+                        etot0=etots[0], etot=etots[-1])
+            else:
+                t.event("field_health", it=n, nonfinite=nan_steps,
+                        fields={"du": nan_steps}, hint="--debug-checks")
+        t.close()
+        write_manifest(str(d), particles=512)
+        return str(d)
+
+    def test_science_renders_and_exit_codes(self, tmp_path, capsys):
+        run = self._make_science_run(tmp_path, "clean", [1.0, 1.0, 1.0])
+        assert cli_main(["science", run]) == 0
+        out = capsys.readouterr().out
+        assert "|drift| max" in out and "timestep limiter" in out
+        assert "courant" in out and "extrema timeline" in out
+        assert cli_main(["science", run, "--format", "json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["steps"] == 3 and s["drift"]["max"] == 0.0
+        assert s["limiter"] == {"courant": 2, "growth": 1}
+        # budget gate: 10% drift against a 5% budget fails, 20% passes
+        leaky = self._make_science_run(tmp_path, "leaky", [1.0, 1.05, 1.1])
+        assert cli_main(["science", leaky, "--budget", "0.05"]) == 1
+        capsys.readouterr()
+        assert cli_main(["science", leaky, "--budget", "0.2"]) == 0
+        capsys.readouterr()
+        # without a budget, in-run watchdog events decide the exit code
+        fired = self._make_science_run(tmp_path, "fired", [1.0, 1.5],
+                                       watchdogs=("drift",))
+        assert cli_main(["science", fired]) == 1
+        capsys.readouterr()
+        sick = self._make_science_run(tmp_path, "sick", [1.0, float("nan")],
+                                      nan_steps=3,
+                                      watchdogs=("field_health",))
+        assert cli_main(["science", sick]) == 1
+        out = capsys.readouterr().out
+        assert "field-health events" in out
+
+    def test_science_partial_run_no_traceback(self, tmp_path, capsys):
+        """Satellite regression: a run that crashed before its first
+        flush (launch events only, possibly a truncated trailing line)
+        must render partial output from BOTH summary and science — exit
+        codes, never tracebacks."""
+        d = tmp_path / "crashed"
+        t = Telemetry(sinks=[JsonlSink(str(d / "events.jsonl"))])
+        t.event("reconfigure", it=0, reason="initial")
+        for i in (1, 2, 3):
+            t.event("launch", it=i)
+        t.close()
+        write_manifest(str(d), particles=64)
+        with open(d / "events.jsonl", "a") as f:
+            f.write('{"v":3,"seq":99,"t":1.0,"kind":"phys')  # killed mid-write
+        assert cli_main(["summary", str(d)]) == 0
+        out = capsys.readouterr().out
+        assert "steps" in out and "schema: line 5" in out
+        assert cli_main(["science", str(d)]) == 1  # no ledger: must fail
+        assert "no physics telemetry" in capsys.readouterr().out
+        # strict still flags the truncated line without crashing
+        assert cli_main(["summary", str(d), "--strict"]) == 1
+
+    def test_diff_drift_threshold_exit_codes(self, tmp_path, capsys):
+        base = self._make_science_run(tmp_path, "dbase",
+                                      [1.0, 1.001, 1.002])  # 0.2% drift
+        cand = self._make_science_run(tmp_path, "dcand",
+                                      [1.0, 1.005, 1.01])   # 1% drift
+        # drift x5 vs baseline: regression beyond a 100% threshold
+        assert cli_main(["diff", base, cand, "--drift",
+                         "--threshold", "1.0"]) == 1
+        assert "energy_drift_max" in capsys.readouterr().out
+        assert cli_main(["diff", base, cand, "--drift",
+                         "--threshold", "10.0"]) == 0
+        capsys.readouterr()
+        # improving drift never regresses
+        assert cli_main(["diff", cand, base, "--drift",
+                         "--threshold", "1.0"]) == 0
+        capsys.readouterr()
+        # without --drift the drift row informs but cannot regress
+        assert cli_main(["diff", base, cand, "--threshold", "1.0"]) == 0
+        capsys.readouterr()
+        # --drift needs physics telemetry on both sides
+        plain = _make_run(tmp_path, "noledger", [0.1])
+        assert cli_main(["diff", base, plain, "--drift"]) == 2
+        assert "--drift" in capsys.readouterr().err
+
     def test_app_writes_manifest_and_events(self, tmp_path):
         from sphexa_tpu.app.main import main as app_main
         from sphexa_tpu.telemetry.cli import summarize_run
@@ -621,3 +858,5 @@ class TestCli:
         assert s["manifest"]["config"]["prop"] == "std"
         assert s["phase_mean_s"]  # Timer laps flowed through as phases
         assert cli_main(["summary", tdir, "--strict"]) == 0
+        # the in-graph ledger made it into the record: science renders
+        assert cli_main(["science", tdir]) == 0
