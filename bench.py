@@ -172,12 +172,37 @@ def main() -> int:
     sim = Simulation(state, box, const, prop="std", block=8192,
                      check_every=STEPS, telemetry=tel,
                      obs_spec=ObservableSpec())
+    # BENCH_TRACE_DIR: capture a jax.profiler trace of the headline
+    # window and stamp its per-phase attribution into the JSON — the
+    # chip-harvest workflow (docs/NEXT.md round 8: every bench round
+    # carries its phase table, `sphexa-telemetry trace` re-renders it)
+    trace_dir = os.environ.get("BENCH_TRACE_DIR")
+    phase_attr = None
+    if trace_dir:
+        import jax
+
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
     std_ups = _measure(sim, n, STEPS)
+    if trace_dir:
+        jax.profiler.stop_trace()
+        print(f"bench: profiler trace -> {trace_dir}", file=sys.stderr)
+        try:
+            from sphexa_tpu.telemetry.traceview import (
+                phase_attr_digest,
+                summarize_trace,
+            )
+
+            phase_attr = phase_attr_digest(summarize_trace(trace_dir))
+        except Exception as e:  # attribution must never sink the bench
+            print(f"bench: trace attribution failed: {e}", file=sys.stderr)
     if std_ups is None:
         print("bench: no reconfigure-free window in 3 attempts", file=sys.stderr)
         return 1
 
     extra = {}
+    if phase_attr is not None:
+        extra["phase_attr"] = phase_attr
     # conservation health of the benched run, free from the in-graph
     # ledger (|etot - etot0| / |etot0| at the last flush): a perf win
     # that leaks energy is not a win, so the bench line carries its own

@@ -1,11 +1,13 @@
 #!/usr/bin/env bash
 # Repo gate: jaxlint (AST) -> jaxaudit (trace) -> telemetry smoke ->
-# tier-1 tests — what CI (and a pre-push hook) runs.
+# history/regression lock -> tier-1 tests — what CI (and a pre-push
+# hook) runs.
 #
-#   scripts/check.sh                  # lint + audit + telemetry + fast tier
+#   scripts/check.sh                  # lint + audit + telemetry + history + fast tier
 #   scripts/check.sh --lint-only
 #   scripts/check.sh --audit-only
 #   scripts/check.sh --telemetry-only
+#   scripts/check.sh --history-only
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -41,14 +43,35 @@ run_telemetry() {
     dir=$(mktemp -d)
     env JAX_PLATFORMS=cpu python -m sphexa_tpu.app.main \
         --init sedov -n 8 -s 5 --quiet \
-        --telemetry-dir "$dir/run" -o "$dir/out"
+        --telemetry-dir "$dir/run" --trace-dir "$dir/trace" -o "$dir/out"
     rc=$?
     if [ $rc -ne 0 ]; then
         echo "telemetry smoke run failed (rc=$rc)"
         rm -rf "$dir"
         exit $rc
     fi
-    # --strict: every event must validate against the schema (v3; v1/v2
+    # phase attribution (schema v4, the chip-harvest acceptance gate):
+    # >= 80% of the capture's device-op time must land in named
+    # sphexa/<phase> scopes — a refactor that strips the named scopes,
+    # or a traceview regression, fails HERE on the CPU profiler
+    python -m sphexa_tpu.telemetry trace "$dir/trace" --min-coverage 0.8
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "sphexa-telemetry trace failed (rc=$rc): phase attribution"
+        echo "below 80% or no sphexa/ scopes in the capture"
+        echo "(util/phases.py, tests/test_phase_attr.py)."
+        exit $rc
+    fi
+    # a clean run must leave NO crash blackbox (the flight recorder
+    # disarms on close; a dump here means an exit path skipped it)
+    if [ -f "$dir/run/blackbox.json" ]; then
+        echo "clean smoke run left a blackbox.json — the flight recorder"
+        echo "was not disarmed on the clean-exit path (telemetry/flightrec.py)"
+        rm -rf "$dir"
+        exit 1
+    fi
+    # --strict: every event must validate against the schema (v4; v1-v3
     # files keep validating via SUPPORTED_VERSIONS, pinned in tests)
     python -m sphexa_tpu.telemetry summary "$dir/run" --strict
     rc=$?
@@ -112,6 +135,55 @@ run_telemetry() {
     fi
 }
 
+run_history() {
+    echo "== history + regression lock (trend render, TELEMETRY_LOCK gate) =="
+    local tmp rc
+    # the committed rounds must render as one trend (exit 0)
+    python -m sphexa_tpu.telemetry history
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "sphexa-telemetry history failed (rc=$rc) over the committed"
+        echo "BENCH_r*/MULTICHIP_r* rounds (telemetry/history.py)."
+        exit $rc
+    fi
+    # the committed lock must HOLD against the committed sources: a
+    # chip-less PR cannot regress a locked, chip-measured number
+    python -m sphexa_tpu.telemetry regress --lock TELEMETRY_LOCK.json
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "regression vs TELEMETRY_LOCK.json (rc=$rc): a locked,"
+        echo "chip-measured metric regressed or its source went missing."
+        echo "If a relock is intentional (new chip round committed):"
+        echo "  sphexa-telemetry regress --lock TELEMETRY_LOCK.json --write"
+        exit $rc
+    fi
+    # exit-code contract smoke: a doctored lock (impossible chip number)
+    # must fail with 1, an unreadable lock with 2 — the gate's teeth
+    tmp=$(mktemp -d)
+    python - "$tmp" <<'EOF'
+import json, sys
+lock = json.load(open("TELEMETRY_LOCK.json"))
+lock["metrics"][0]["value"] *= 100.0
+json.dump(lock, open(sys.argv[1] + "/doctored.json", "w"))
+open(sys.argv[1] + "/corrupt.json", "w").write("{not json")
+EOF
+    python -m sphexa_tpu.telemetry regress \
+        --lock "$tmp/doctored.json" --root . >/dev/null
+    if [ $? -ne 1 ]; then
+        echo "regress failed to flag a doctored lock (expected exit 1)"
+        rm -rf "$tmp"
+        exit 1
+    fi
+    python -m sphexa_tpu.telemetry regress \
+        --lock "$tmp/corrupt.json" --root . 2>/dev/null
+    if [ $? -ne 2 ]; then
+        echo "regress failed to reject a corrupt lock (expected exit 2)"
+        rm -rf "$tmp"
+        exit 1
+    fi
+    rm -rf "$tmp"
+}
+
 run_multichip_diff() {
     echo "== multi-chip comm-volume gate (measure_multichip --quick vs baseline) =="
     local tmp rc
@@ -153,11 +225,16 @@ case "${1:-}" in
         run_telemetry
         exit 0
         ;;
+    --history-only)
+        run_history
+        exit 0
+        ;;
 esac
 
 run_lint
 run_audit
 run_telemetry
+run_history
 run_multichip_diff
 
 echo "== tier-1 tests (fast tier, CPU) =="

@@ -5,6 +5,14 @@ incremental jitted programs: multipoles / accept sweep / +downsweep /
 the 975 ms (round-4 measurement, tb=256).
 
 Usage: [N_PARTS=1000000] python scripts/profile_gravity_phases.py
+
+Recording the results (chip-harvest protocol, docs/NEXT.md round 8):
+set TRACE_DIR=/path to also capture a jax.profiler trace of the full
+solve — the production gravity stages carry sphexa/gravity-upsweep/
+-mac/-m2p/-p2p named scopes, so `sphexa-telemetry trace $TRACE_DIR`
+renders the same phase split from device-op metadata (the durable,
+diffable record; the incremental re-timings below remain the
+fine-grained cross-check).
 """
 
 import os
@@ -203,6 +211,20 @@ def main():
         print(f"solve [{tag}]: {t*1e3:8.1f} ms   compact_width="
               f"{int(dd['compact_width'])} c_max={int(dd['c_max'])} "
               f"m2p_max={int(dd['m2p_max'])}")
+
+    # the durable record: capture the tuned solve under the profiler and
+    # attribute by the in-graph gravity phases (sphexa-telemetry trace)
+    trace_dir = os.environ.get("TRACE_DIR")
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        for _ in range(2):
+            jax.block_until_ready(compute_gravity(
+                xs, ys, zs, ms, hs, skeys, box, gtree, meta, cfg,
+                mp_cache=mpc))
+        jax.profiler.stop_trace()
+        print(f"trace -> {trace_dir}  (render: sphexa-telemetry trace "
+              f"{trace_dir})")
 
 
 if __name__ == "__main__":

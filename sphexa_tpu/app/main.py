@@ -303,10 +303,21 @@ def main(argv=None) -> int:
     from sphexa_tpu.telemetry import JsonlSink, Telemetry
 
     sinks = []
+    recorder = None
     if args.telemetry_dir:
         sinks.append(JsonlSink(os.path.join(args.telemetry_dir,
                                             "events.jsonl")))
     telemetry = Telemetry(sinks=sinks)
+    if args.telemetry_dir:
+        # crash flight recorder: ring-buffer the event tail and dump
+        # blackbox.json (+ a first-class ``crash`` event) on abnormal
+        # exit, so a killed/OOM'd/aborted run EXPLAINS its truncated
+        # events.jsonl (telemetry/flightrec.py; summary/science read it)
+        from sphexa_tpu.telemetry import FlightRecorder
+
+        recorder = FlightRecorder(args.telemetry_dir, telemetry=telemetry)
+        telemetry.sinks.append(recorder.sink)
+        recorder.install()
     try:
         sim = Simulation(state, box, const, prop=args.prop,
                          av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
@@ -323,12 +334,17 @@ def main(argv=None) -> int:
                          debug_checks=args.debug_checks, telemetry=telemetry)
     except (NotImplementedError, ValueError) as e:
         print(str(e), file=sys.stderr)
+        if recorder is not None:
+            # a run that cannot even construct is an abnormal end: leave
+            # a blackbox naming the cause, then disarm cleanly
+            recorder.dump(reason=f"simulation construction failed: {e}")
+            recorder.close()
         return 2
     if args.telemetry_dir:
         from sphexa_tpu.telemetry import emit_memory_event, write_manifest
 
         mesh = getattr(sim, "_mesh", None)
-        write_manifest(
+        recorder.manifest = write_manifest(
             args.telemetry_dir,
             config={k: v for k, v in vars(args).items()
                     if isinstance(v, (str, int, float, bool, type(None)))},
@@ -405,6 +421,8 @@ def main(argv=None) -> int:
         except ValueError:
             print(f"--wextra: cannot parse {tok!r} (expected comma-separated "
                   "integers or floats)", file=sys.stderr)
+            if recorder is not None:
+                recorder.close()  # usage error, not a crash: no blackbox
             return 2
         if val.is_integer() and "." not in tok:
             wextra_steps.add(int(val))
@@ -531,6 +549,8 @@ def main(argv=None) -> int:
                                every=args.insitu_every)
         except ValueError as e:
             print(str(e), file=sys.stderr)
+            if recorder is not None:
+                recorder.close()  # usage error, not a crash: no blackbox
             return 2
         insitu.init()
 
@@ -623,6 +643,26 @@ def main(argv=None) -> int:
         if args.trace_dir:
             _jax.profiler.stop_trace()
             log(f"# profiler trace -> {args.trace_dir}")
+            # in-run phase attribution (schema v4): aggregate the capture
+            # by sphexa/<phase> scope right here so the run record itself
+            # carries the per-phase device-time table (`sphexa-telemetry
+            # trace <dir>` re-renders it offline); a failed parse must
+            # never take the run down with it
+            try:
+                from sphexa_tpu.telemetry.traceview import (
+                    phase_attr_digest,
+                    summarize_trace,
+                )
+
+                s = summarize_trace(args.trace_dir, top=3)
+                telemetry.event("phase_attr", dir=args.trace_dir,
+                                **phase_attr_digest(s))
+                log("# phase attribution: "
+                    + " ".join(f"{p['phase']}={p['share']:.0%}"
+                               for p in s["phases"][:5])
+                    + f" (coverage {s['coverage']:.0%})")
+            except Exception as e:
+                print(f"# trace attribution failed: {e}", file=sys.stderr)
     # drain any open deferred window (--check-every > 1, -s not a
     # multiple): the state must be verified before the final report, the
     # telemetry window/flush events must land (Simulation.run's trailing
@@ -666,6 +706,8 @@ def main(argv=None) -> int:
                   "written", file=sys.stderr)
     telemetry.event("run_end", iterations=n_done, wall_s=round(dt_wall, 3))
     telemetry.close()
+    if recorder is not None:
+        recorder.close()  # clean exit: disarm the crash hooks, no blackbox
     log(f"# {n_done} iterations in {dt_wall:.2f}s "
         f"({state.n * n_done / dt_wall / 1e6:.3f}M particle-updates/s)")
     return 0

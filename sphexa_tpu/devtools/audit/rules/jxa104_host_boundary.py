@@ -8,7 +8,12 @@ in another module). Debug prints count too: ``jax.debug.print`` lowers to
 ``debug_callback`` and serializes the device stream.
 
 ``with_sharding_constraint``/collectives are NOT flagged — they are
-device-side. The deny set is the callback/transfer family. ``device_put``
+device-side. ``jax.named_scope`` (the sphexa/<phase> attribution
+scopes, util/phases.py) never appears here at all: it pushes a
+tracing-time name stack and lowers to NO primitive, so the phase
+taxonomy is invisible to this rule by construction (pinned by the
+audit gate staying at zero findings with every step entry scoped).
+The deny set is the callback/transfer family. ``device_put``
 needs care: jax stages ``jnp.asarray(np_constant)`` inside a traced body
 as a device_put eqn with no target (``devices=[None]``, alias
 semantics) — that is constant staging, not a transfer (JXA105 budgets

@@ -39,6 +39,7 @@ from sphexa_tpu.sfc.box import Box, apply_pbc_xyz
 from sphexa_tpu.sfc.hilbert import hilbert_encode
 from sphexa_tpu.sfc.keys import coords_to_igrid
 from sphexa_tpu.sfc.morton import morton_encode
+from sphexa_tpu.util.phases import named_phase
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,6 +155,7 @@ def _window_offsets(window: int) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
+@named_phase("neighbors")
 def find_neighbors(
     x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:

@@ -32,6 +32,7 @@ from sphexa_tpu.observables.extras import (
     mach_rms,
     wind_bubble_fraction,
 )
+from sphexa_tpu.util.phases import named_phase
 
 #: conservation-ledger scalars the step tail emits whenever a
 #: PropagatorConfig.obs spec is set (the app/bench always set one; bare
@@ -108,6 +109,7 @@ def make_observable_spec(case: str,
     return ObservableSpec(extra=kind)
 
 
+@named_phase("ledger")
 def ledger_diagnostics(state, rho, nc, const, ngmax: int,
                        spec: Optional[ObservableSpec] = None, egrav=0.0,
                        box=None, c=None, smoothing: bool = True,

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
 from sphexa_tpu.sph.pallas_pairs import GroupRanges
+from sphexa_tpu.util.phases import named_phase
 
 # numpy, NOT jnp: this module is first imported INSIDE jitted stage
 # functions, and a module-level jnp constant created under an active
@@ -89,6 +90,7 @@ def estimate_halo_window(
     return min(padded, S)
 
 
+@named_phase("halo-exchange")
 def global_cell_table(local_keys, level: int, axis: str) -> jax.Array:
     """Cell-starts table of the level-``level`` grid over the DISTRIBUTED
     key array: per-shard cid histogram -> psum -> exclusive cumsum.
@@ -184,6 +186,7 @@ def _effective_lo(bounds_all, S: int, Wmax: int, P: int):
     return jnp.clip(lo, srcs * S, (srcs + 1) * S - Wmax)
 
 
+@named_phase("halo-exchange")
 def serve_windows(fields: Sequence, bounds_all, S: int, Wmax: int,
                   P: int, k, axis: str):
     """One all_to_all exchange round: this shard serves every
@@ -233,6 +236,7 @@ def shard_halo_stage(x, y, z, h, keys, box, nbr, P: int, Wmax: int,
     return ranges, serve, jbuf, escaped, metrics
 
 
+@named_phase("shard-metrics")
 def exchange_metrics_windowed(bounds_all, Wmax: int, P: int, k):
     """Per-shard comm telemetry of the windowed exchange, from the
     already-negotiated (P_dest, P_src, 2) bounds matrix: ``halo_rows`` =
@@ -343,6 +347,7 @@ def chain_after(x, dep):
     return jax.lax.optimization_barrier((x, dep))[0]
 
 
+@named_phase("halo-exchange")
 def serve_sparse(fields: Sequence, covered_all, table, S: int,
                  hmax: Tuple[int, ...], P: int, k, axis: str,
                  token=None):
@@ -393,6 +398,7 @@ def _sparse_layout_dest(covered_all, dest, table, S: int, k):
     return clen, csum - clen
 
 
+@named_phase("halo-exchange")
 def localize_ranges_sparse(
     ranges: GroupRanges, table, S: int, P: int, hmax: Tuple[int, ...],
     k, axis: str,
@@ -490,6 +496,7 @@ def shard_halo_stage_sparse(x, y, z, h, keys, box, nbr, P: int,
     return ranges, serve, jbuf, escaped, metrics
 
 
+@named_phase("shard-metrics")
 def exchange_metrics_sparse(covered, table, S: int,
                             hmax: Tuple[int, ...], P: int, k):
     """Per-shard comm telemetry of the sparse exchange, from this
@@ -510,6 +517,7 @@ def exchange_metrics_sparse(covered, table, S: int,
     return {"halo_rows": rows, "halo_occ": occ}
 
 
+@named_phase("halo-exchange")
 def localize_ranges(
     ranges: GroupRanges, S: int, P: int, Wmax: int, k, axis: str,
 ) -> Tuple[GroupRanges, jax.Array, jax.Array]:

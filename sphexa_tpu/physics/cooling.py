@@ -128,7 +128,10 @@ class ChemistryData:
     hei: jax.Array
     heii: jax.Array
     heiii: jax.Array
-    e: jax.Array       # electron fraction (per H)
+    # electron abundance as a per-MASS number fraction y_e = n_e m_H/rho
+    # (the same convention primordial._y_of passes through unchanged:
+    # fully-ionized primordial gives y_e = X + Y/2, NOT "per H")
+    e: jax.Array
     metal: jax.Array
 
     @staticmethod

@@ -35,6 +35,7 @@ with lam24 = Lambda * 1e24 (O(1)) and R0/C0 computed host-side in f64.
 """
 
 import dataclasses
+from typing import Optional
 
 import numpy as np
 import jax
@@ -136,16 +137,23 @@ def species_cooling24(T, y):
     )
 
 
-def metal_cooling24(T, metal, cfg, x_h: float = 0.76):
+def metal_cooling24(T, metal, cfg, x_h: Optional[float] = None):
     """Metal-line cooling on top of the primordial network — the
     GRACKLE decomposition (primordial network + Cloudy metal table,
     cooler.cpp metal_cooling flag): the metal channel is the RESIDUAL
     of the solar-metallicity CIE table over the primordial network's
     own equilibrium cooling at the same T, scaled linearly in the
     particle's metal mass fraction. Returns the lam24-normalized rate
-    per (rho/m_H)^2 (the same units species_cooling24 uses)."""
+    per (rho/m_H)^2 (the same units species_cooling24 uses).
+
+    ``x_h`` defaults to ``cfg.hydrogen_fraction`` so a non-default
+    composition gets the matching n_H^2 conversion (it used to
+    hard-code 0.76, silently mis-scaling the table rate for any other
+    CoolingConfig — ADVICE round 5)."""
     from sphexa_tpu.physics.cooling import _log_lambda_cie
 
+    if x_h is None:
+        x_h = cfg.hydrogen_fraction
     # table rate is per n_H^2 = (x_h rho/m_H)^2; convert to per
     # (rho/m_H)^2 with x_h^2
     lam_cie24 = 10.0 ** (_log_lambda_cie(T, cfg) + 24.0) * x_h**2

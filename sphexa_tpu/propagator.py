@@ -40,6 +40,7 @@ from sphexa_tpu.sph.timestep import (
     compute_timestep,
     rho_timestep,
 )
+from sphexa_tpu.util.phases import phase_scope
 
 try:  # jax >= 0.6 exports shard_map at the top level
     from jax import shard_map as _jax_shard_map
@@ -171,9 +172,12 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
     extra pytree of per-particle arrays (e.g. ChemistryData) permuted
     identically so it stays aligned with the persisted sorted state.
     """
-    keys = compute_sfc_keys(state.x, state.y, state.z, box, curve=curve)
-    order = jnp.argsort(keys)
-    sorted_keys = keys[order]
+    # sphexa/sort: the whole keygen + argsort + permute program is one
+    # attribution phase (profiler traces; util/phases.py taxonomy)
+    with phase_scope("sort"):
+        keys = compute_sfc_keys(state.x, state.y, state.z, box, curve=curve)
+        order = jnp.argsort(keys)
+        sorted_keys = keys[order]
     n = state.n
 
     def permute_tree(tree):
@@ -198,7 +202,8 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
                 leaves[i] = mat[:, k]
         return jax.tree.unflatten(treedef, leaves)
 
-    return permute_tree(state), sorted_keys, permute_tree(aux)
+    with phase_scope("sort"):
+        return permute_tree(state), sorted_keys, permute_tree(aux)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -211,13 +216,15 @@ def rebuild_pair_lists(state: ParticleState, box: Box,
     current h_max, so it tracks the evolving resolution."""
     from sphexa_tpu.sph.pair_lists import build_pair_lists
 
-    box = make_global_box(state.x, state.y, state.z, box)
+    with phase_scope("sort"):
+        box = make_global_box(state.x, state.y, state.z, box)
     state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
-    skin = jnp.float32(cfg.list_skin_rel) * 2.0 * jnp.max(state.h)
-    lists = build_pair_lists(
-        state.x, state.y, state.z, state.h, keys, box, cfg.nbr,
-        skin, cfg.list_slot_cap, interpret=_pallas_interpret(),
-    )
+    with phase_scope("neighbors"):
+        skin = jnp.float32(cfg.list_skin_rel) * 2.0 * jnp.max(state.h)
+        lists = build_pair_lists(
+            state.x, state.y, state.z, state.h, keys, box, cfg.nbr,
+            skin, cfg.list_slot_cap, interpret=_pallas_interpret(),
+        )
     return state, box, lists, aux
 
 
@@ -314,7 +321,8 @@ def _add_gravity(state, box, keys, cfg, gtree, ax, ay, az):
             gtree, cfg.grav_meta, gcfg,
         )
     ax, ay, az = ax + gx, ay + gy, az + gz
-    dt_acc = acceleration_timestep(ax, ay, az, cfg.const)
+    with phase_scope("timestep"):
+        dt_acc = acceleration_timestep(ax, ay, az, cfg.const)
     return ax, ay, az, egrav, dt_acc, gdiag
 
 
@@ -332,32 +340,34 @@ def _integrate_and_finish(
     reference's per-iteration conserved_quantities sweep moved inside
     the step program) plus whatever extras the caller rides along."""
     const = cfg.const
-    fields = (state.x, state.y, state.z, state.x_m1, state.y_m1, state.z_m1,
-              state.vx, state.vy, state.vz, state.h, state.temp,
-              state.temp_lo, du, state.du_m1)
-    (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, temp_lo, du,
-     du_m1) = compute_positions(
-        fields, ax, ay, az, dt, state.min_dt, box, const
-    )
-    new_h = update_h(const.ng0, nc + 1, h) if update_smoothing else h
-    new_state = dataclasses.replace(
-        state,
-        x=nx, y=ny, z=nz, x_m1=dxm, y_m1=dym, z_m1=dzm,
-        vx=vx, vy=vy, vz=vz, h=new_h, temp=temp, temp_lo=temp_lo, du=du,
-        du_m1=du_m1,
-        ttot=state.ttot + dt, min_dt=dt, min_dt_m1=state.min_dt,
-        **(extra or {}),
-    )
-    diagnostics = {
-        "dt": dt,
-        "nc_mean": jnp.mean(nc.astype(jnp.float32)) + 1.0,
-        "nc_max": jnp.max(nc) + 1,
-        "occupancy": occ,
-        "rho_max": jnp.max(rho),
-        # computed in-step so the host never launches a separate reduction
-        # (device->host round trips are expensive over remote links)
-        "h_max": jnp.max(new_h),
-    }
+    with phase_scope("integrate"):
+        fields = (state.x, state.y, state.z, state.x_m1, state.y_m1,
+                  state.z_m1, state.vx, state.vy, state.vz, state.h,
+                  state.temp, state.temp_lo, du, state.du_m1)
+        (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, temp_lo, du,
+         du_m1) = compute_positions(
+            fields, ax, ay, az, dt, state.min_dt, box, const
+        )
+        new_h = update_h(const.ng0, nc + 1, h) if update_smoothing else h
+        new_state = dataclasses.replace(
+            state,
+            x=nx, y=ny, z=nz, x_m1=dxm, y_m1=dym, z_m1=dzm,
+            vx=vx, vy=vy, vz=vz, h=new_h, temp=temp, temp_lo=temp_lo,
+            du=du, du_m1=du_m1,
+            ttot=state.ttot + dt, min_dt=dt, min_dt_m1=state.min_dt,
+            **(extra or {}),
+        )
+        diagnostics = {
+            "dt": dt,
+            "nc_mean": jnp.mean(nc.astype(jnp.float32)) + 1.0,
+            "nc_max": jnp.max(nc) + 1,
+            "occupancy": occ,
+            "rho_max": jnp.max(rho),
+            # computed in-step so the host never launches a separate
+            # reduction (device->host round trips are expensive over
+            # remote links)
+            "h_max": jnp.max(new_h),
+        }
     # conservation + numerics-health ledger over the post-integration
     # state (the pairing the app's eager recompute used: new positions/
     # velocities/temp with the force stage's rho/c); egrav is the force
@@ -431,22 +441,23 @@ def _shard_metrics(ranges, escaped, metrics, axis: str, token=None):
     collective-rendezvous guard; see parallel/exchange.py)."""
     from sphexa_tpu.parallel.exchange import chain_after
 
-    work = jnp.sum(ranges.lens.astype(jnp.float32))
-    packed = jnp.stack([
-        metrics["halo_rows"].astype(jnp.float32),
-        metrics["halo_occ"].astype(jnp.float32),
-        work,
-        jnp.asarray(escaped, jnp.float32),
-    ])
-    if token is not None:
-        packed = chain_after(packed, token)
-    g = jax.lax.all_gather(packed, axis)  # (P, 4) replicated
-    return {
-        "shard_rows": g[:, 0].astype(jnp.int32),
-        "shard_occ": g[:, 1],
-        "shard_work": g[:, 2],
-        "shard_trips": g[:, 3].astype(jnp.int32),
-    }
+    with phase_scope("shard-metrics"):
+        work = jnp.sum(ranges.lens.astype(jnp.float32))
+        packed = jnp.stack([
+            metrics["halo_rows"].astype(jnp.float32),
+            metrics["halo_occ"].astype(jnp.float32),
+            work,
+            jnp.asarray(escaped, jnp.float32),
+        ])
+        if token is not None:
+            packed = chain_after(packed, token)
+        g = jax.lax.all_gather(packed, axis)  # (P, 4) replicated
+        return {
+            "shard_rows": g[:, 0].astype(jnp.int32),
+            "shard_occ": g[:, 1],
+            "shard_work": g[:, 2],
+            "shard_trips": g[:, 3].astype(jnp.int32),
+        }
 
 
 def _std_forces_sharded(state, box, cfg: PropagatorConfig, keys):
@@ -645,13 +656,15 @@ def _force_stage_prologue(state, box, cfg: PropagatorConfig, lists, aux=None):
             raise NotImplementedError(
                 "persistent lists compose with single-device gravity-off "
                 "steps; gravity/sharded runs rebuild per step")
-        slack = list_slack(state.x, state.y, state.z, state.h, lists)
-        ldiag = {"list_slack": slack,
-                 "list_ok": (slack >= 0.0).astype(jnp.int32)}
+        with phase_scope("neighbors"):
+            slack = list_slack(state.x, state.y, state.z, state.h, lists)
+            ldiag = {"list_slack": slack,
+                     "list_ok": (slack >= 0.0).astype(jnp.int32)}
         return state, box, None, ldiag, aux
     # grow open-boundary dims to fit drifted particles (box_mpi.hpp
     # role); box limits are traced values, so this never recompiles
-    box = make_global_box(state.x, state.y, state.z, box)
+    with phase_scope("sort"):
+        box = make_global_box(state.x, state.y, state.z, box)
     state, keys, aux = _sort_by_keys(state, box, cfg.curve, aux=aux)
     return state, box, keys, None, aux
 
@@ -749,9 +762,11 @@ def _step_hydro_std(
     """
     (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
      gdiag, _) = _std_forces(state, box, cfg, gtree, lists=lists)
-    dt = compute_timestep(state.min_dt, dt_courant, *extra_dts, const=cfg.const)
-    limiter = _dt_limiter(state.min_dt, cfg.const, courant=dt_courant,
-                          accel=extra_dts[0] if extra_dts else None)
+    with phase_scope("timestep"):
+        dt = compute_timestep(state.min_dt, dt_courant, *extra_dts,
+                              const=cfg.const)
+        limiter = _dt_limiter(state.min_dt, cfg.const, courant=dt_courant,
+                              accel=extra_dts[0] if extra_dts else None)
     return _integrate_and_finish(
         state, box, cfg, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
         c=c, dt_limiter=limiter,
@@ -777,21 +792,25 @@ def _step_hydro_std_cooling(
      gdiag, chem) = _std_forces(state, box, cfg, gtree, aux=chem,
                                 lists=lists)
 
-    u = const.cv * state.temp
-    dt_cool = cool_timestep(rho, u, chem, cool_cfg)
-    dt = compute_timestep(
-        state.min_dt, dt_courant, dt_cool, *extra_dts, const=const
-    )
+    with phase_scope("cooling"):
+        u = const.cv * state.temp
+        dt_cool = cool_timestep(rho, u, chem, cool_cfg)
+    with phase_scope("timestep"):
+        dt = compute_timestep(
+            state.min_dt, dt_courant, dt_cool, *extra_dts, const=const
+        )
     # evolved-network mode advances the species alongside u
     # (solve_chemistry, cooler.cpp:313); CIE mode passes chem through
-    du_cool, chem = cool_step(dt, rho, u, chem, cool_cfg)
-    du = du + du_cool
+    with phase_scope("cooling"):
+        du_cool, chem = cool_step(dt, rho, u, chem, cool_cfg)
+        du = du + du_cool
 
     gdiag = {**(gdiag or {}), "dt_cool": dt_cool,
              "du_cool_min": jnp.min(du_cool)}
-    limiter = _dt_limiter(state.min_dt, const, courant=dt_courant,
-                          cool=dt_cool,
-                          accel=extra_dts[0] if extra_dts else None)
+    with phase_scope("timestep"):
+        limiter = _dt_limiter(state.min_dt, const, courant=dt_courant,
+                              cool=dt_cool,
+                              accel=extra_dts[0] if extra_dts else None)
     new_state, box, diag = _integrate_and_finish(
         state, box, cfg, ax, ay, az, du, dt, nc, occ, rho, extra_diag=gdiag,
         c=c, dt_limiter=limiter,
@@ -931,12 +950,14 @@ def _ve_forces(
     if sdiag is not None:
         gdiag = {**(gdiag or {}), **sdiag}
 
-    dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts, const=const)
-    # limiter attribution rides gdiag into the step diagnostics (the ve
-    # builders hand gdiag to the shared tail as extra_diag)
-    gdiag = {**(gdiag or {}), "dt_limiter": _dt_limiter(
-        state.min_dt, const, courant=dt_courant, rho=dt_rho,
-        accel=extra_dts[0] if extra_dts else None)}
+    with phase_scope("timestep"):
+        dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts,
+                              const=const)
+        # limiter attribution rides gdiag into the step diagnostics (the
+        # ve builders hand gdiag to the shared tail as extra_diag)
+        gdiag = {**(gdiag or {}), "dt_limiter": _dt_limiter(
+            state.min_dt, const, courant=dt_courant, rho=dt_rho,
+            accel=extra_dts[0] if extra_dts else None)}
     return state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag
 
 
@@ -972,9 +993,10 @@ def _step_turb_ve(
     (state, box, ax, ay, az, du, dt, alpha, nc, occ, rho, c, gdiag) = _ve_forces(
         state, box, cfg, gtree, lists=lists
     )
-    ax, ay, az, turb = drive_turbulence(
-        state.x, state.y, state.z, ax, ay, az, dt, turb, turb_cfg
-    )
+    with phase_scope("turbulence"):
+        ax, ay, az, turb = drive_turbulence(
+            state.x, state.y, state.z, ax, ay, az, dt, turb, turb_cfg
+        )
     new_state, box, diag = _integrate_and_finish(
         state, box, cfg, ax, ay, az, du, dt, nc, occ, rho,
         extra={"alpha": alpha}, extra_diag=gdiag, c=c,
@@ -992,15 +1014,17 @@ def _step_nbody(
     timestep -> position update. No hydro fields are touched (du = 0).
     """
     const = cfg.const
-    box = make_global_box(state.x, state.y, state.z, box)
+    with phase_scope("sort"):
+        box = make_global_box(state.x, state.y, state.z, box)
     state, keys, _ = _sort_by_keys(state, box, cfg.curve)
 
     zero = jnp.zeros_like(state.x)
     ax, ay, az, egrav, dt_acc, gdiag = _add_gravity(
         state, box, keys, cfg, gtree, zero, zero, zero
     )
-    dt = compute_timestep(state.min_dt, dt_acc, const=const)
-    limiter = _dt_limiter(state.min_dt, const, accel=dt_acc)
+    with phase_scope("timestep"):
+        dt = compute_timestep(state.min_dt, dt_acc, const=const)
+        limiter = _dt_limiter(state.min_dt, const, accel=dt_acc)
 
     nc = jnp.zeros_like(state.x, dtype=jnp.int32)
     return _integrate_and_finish(

@@ -470,6 +470,9 @@ class Simulation:
         # alive and replay the window if a deferred check finds an
         # overflow.
         self.check_every = max(1, check_every)
+        # executable signatures THIS run has launched (compile-watchdog
+        # per-run baseline; see _launch_signature)
+        self._launched_sigs: set = set()
         self._pending = []  # per-step diagnostics of the open window
         self._window_prior = None  # sim state refs at the window start
         self._last_diag: Dict[str, float] = {"reconfigured": 0.0}
@@ -837,27 +840,59 @@ class Simulation:
                 total += size()
         return total
 
+    def _launch_signature(self, donate_now: bool):
+        """Hashable identity of the executable THIS launch needs — the
+        per-run half of the compile watchdog. The jit caches are
+        process-global, so the cache-size delta alone under-counts when
+        another Simulation in the same process already compiled the
+        identical config (the suite-order coupling between
+        test_simulation_async and the telemetry retrace pin): this run
+        still *traces differently than its own previous launches*, and
+        in any fresh process it would compile. Baselining per Simulation
+        on the signature set makes the watchdog count THIS run's
+        (re)traces under any suite order."""
+        if self.debug_checks:
+            return ("debug", self.prop_name, self._cfg, self.turb_cfg,
+                    self.cooling_cfg)
+        if self._mesh is not None:
+            info = self._halo_info or {}
+            return ("sharded", self.prop_name, self._cfg,
+                    info.get("caps"), info.get("wmax"))
+        return (self.prop_name, self._cfg, self.turb_cfg,
+                self.cooling_cfg, donate_now,
+                self._use_lists and self._lists is not None)
+
     def _launch(self, donate_ok: bool = False):
         """Instrumented dispatch: the compile watchdog samples the active
         jit cache around the launch — any growth means THIS launch traced
         (first compile or a silent retrace) and is recorded as a
         first-class ``retrace`` event instead of vanishing into an
-        unexplained slow step."""
+        unexplained slow step. A launch whose executable signature this
+        Simulation has never used counts too, even when the
+        process-global cache was pre-warmed by another run (``warm``
+        rides the event payload): the watchdog reports per-RUN compile
+        behavior, independent of suite order."""
         c0 = self._compiled_cache_size()
         # debug_checks rebuilds the checkified jit INSIDE the launch on a
         # config change (new object, cache size resets to 1) — identity
         # drift is a from-scratch compile the size delta alone would miss
         fn0 = id(self._checked_cache.get("fn")) if self.debug_checks \
             else None
+        donate_now = donate_ok and self._donate_active
         with self.telemetry.annotate("sphexa:launch"):
             out = self._launch_impl(donate_ok)
         delta = self._compiled_cache_size() - c0
         if (self.debug_checks and delta <= 0
                 and id(self._checked_cache.get("fn")) != fn0):
             delta = 1
-        if delta > 0:
-            self.telemetry.count("retraces", delta)
-            self.telemetry.event("retrace", it=self.iteration, delta=delta)
+        sig = self._launch_signature(donate_now)
+        warm = delta <= 0 and sig not in self._launched_sigs
+        self._launched_sigs.add(sig)
+        if delta > 0 or warm:
+            n = max(delta, 1)
+            self.telemetry.count("retraces", n)
+            self.telemetry.event("retrace", it=self.iteration, delta=n,
+                                 warm=warm)
         return out
 
     def _launch_impl(self, donate_ok: bool = False):

@@ -15,8 +15,10 @@ from sphexa_tpu.sph.kernels import artificial_viscosity, sinc_kernel_u, ts_k_cou
 from sphexa_tpu.sph.pairs import iad_project, mmax, msum, pair_geometry
 from sphexa_tpu.sph.particles import SimConstants
 from sphexa_tpu.util.blocking import blocked_map
+from sphexa_tpu.util.phases import named_phase
 
 
+@named_phase("density")
 def compute_density(x, y, z, h, m, nidx, nmask, box: Box, const: SimConstants, block=2048):
     """rho_i = K h_i^-3 (m_i + sum_j m_j W(|r_ij|/h_i)).
 
@@ -35,12 +37,14 @@ def compute_density(x, y, z, h, m, nidx, nmask, box: Box, const: SimConstants, b
     return blocked_map(body, n, block)
 
 
+@named_phase("eos")
 def compute_eos_std(temp, rho, const: SimConstants):
     """Ideal-gas EOS from temperature (eos.hpp idealGasEOS): returns (p, c)."""
     tmp = const.cv * temp * (const.gamma - 1.0)
     return rho * tmp, jnp.sqrt(tmp)
 
 
+@named_phase("iad")
 def compute_iad(x, y, z, h, vol_j, nidx, nmask, box: Box, const: SimConstants, block=2048):
     """Integral-approach-to-derivatives tensor (Garcia-Senz et al.).
 
@@ -88,6 +92,7 @@ def compute_iad(x, y, z, h, vol_j, nidx, nmask, box: Box, const: SimConstants, b
     return blocked_map(body, n, block)
 
 
+@named_phase("momentum-energy")
 def compute_momentum_energy_std(
     x, y, z, vx, vy, vz, h, m, rho, p, c,
     c11, c12, c13, c22, c23, c33,

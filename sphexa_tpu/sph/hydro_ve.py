@@ -23,8 +23,10 @@ from sphexa_tpu.sph.kernels import (
 from sphexa_tpu.sph.pairs import iad_project, mmax, msum, pair_geometry
 from sphexa_tpu.sph.particles import SimConstants
 from sphexa_tpu.util.blocking import blocked_map
+from sphexa_tpu.util.phases import named_phase
 
 
+@named_phase("xmass")
 def compute_xmass(x, y, z, h, m, nidx, nmask, box: Box, const: SimConstants, block=2048):
     """Generalized volume element xm_i = m_i / rho0_i (xmass_kern.hpp:50-79),
     rho0 the standard kernel-summed density estimate."""
@@ -40,6 +42,7 @@ def compute_xmass(x, y, z, h, m, nidx, nmask, box: Box, const: SimConstants, blo
     return blocked_map(body, n, block)
 
 
+@named_phase("gradh")
 def compute_ve_def_gradh(
     x, y, z, h, m, xm, nidx, nmask, box: Box, const: SimConstants, block=2048
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -76,6 +79,7 @@ def compute_ve_def_gradh(
     return blocked_map(body, n, block)
 
 
+@named_phase("eos")
 def compute_eos_ve(temp, m, kx, xm, gradh, const: SimConstants):
     """VE ideal-gas EOS (hydro_ve/eos.hpp:52-77): returns (prho, c, rho, p).
 
@@ -89,6 +93,7 @@ def compute_eos_ve(temp, m, kx, xm, gradh, const: SimConstants):
     return prho, c, rho, p
 
 
+@named_phase("divv-curlv")
 def compute_iad_divv_curlv(
     x, y, z, vx, vy, vz, h, kx, xm,
     c11, c12, c13, c22, c23, c33,
@@ -144,6 +149,7 @@ def compute_iad_divv_curlv(
     return blocked_map(body, n, block)
 
 
+@named_phase("av-switches")
 def compute_av_switches(
     x, y, z, vx, vy, vz, h, c, kx, xm, divv, alpha,
     c11, c12, c13, c22, c23, c33,
@@ -219,6 +225,7 @@ def _av_rv_correction(rx, ry, rz, eta_ab, eta_crit, gv_i, gv_j):
     return -phi * (d1 + d2)
 
 
+@named_phase("momentum-energy")
 def compute_momentum_energy_ve(
     x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
     c11, c12, c13, c22, c23, c33,

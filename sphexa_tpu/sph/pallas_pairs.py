@@ -46,6 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 from sphexa_tpu.dtypes import KEY_BITS, KEY_DTYPE
 from sphexa_tpu.neighbors.cell_list import NeighborConfig, _window_offsets
 from sphexa_tpu.sfc.box import BoundaryType, Box
+from sphexa_tpu.util.phases import named_phase
 from sphexa_tpu.sfc.hilbert import hilbert_encode
 from sphexa_tpu.sfc.morton import morton_encode
 from sphexa_tpu.sph.kernels import (
@@ -119,6 +120,7 @@ def engine_fold(box: Box, cfg: NeighborConfig) -> bool:
     return any_periodic and cfg.window >= (1 << cfg.level)
 
 
+@named_phase("neighbors")
 def group_cell_ranges(
     x, y, z, h, sorted_keys, box: Box, cfg: NeighborConfig,
     table=None, radius_pad=0.0,
@@ -1115,6 +1117,7 @@ def _op_aabb(jfields: Sequence, box: Box, cfg: NeighborConfig):
     return chunk_aabb_table(jfields[0], jfields[1], jfields[2], cfg.dma_cap)
 
 
+@named_phase("density")
 def pallas_density(
     x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
@@ -1178,6 +1181,7 @@ def pallas_density(
     return rho.reshape(-1)[:n], nc.reshape(-1)[:n], ranges.occupancy
 
 
+@named_phase("iad")
 def pallas_iad(
     x, y, z, h, vol, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
@@ -1267,6 +1271,7 @@ def pallas_iad(
     return tuple(c.reshape(-1)[:n] for c in cs), ranges.occupancy
 
 
+@named_phase("momentum-energy")
 def pallas_momentum_energy_std(
     x, y, z, vx, vy, vz, h, m, rho, p, c,
     c11, c12, c13, c22, c23, c33,
@@ -1409,6 +1414,7 @@ def pallas_momentum_energy_std(
 # ---------------------------------------------------------------------------
 
 
+@named_phase("xmass")
 def pallas_xmass(
     x, y, z, h, m, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
@@ -1425,6 +1431,7 @@ def pallas_xmass(
     return m / rho0, nc, occ
 
 
+@named_phase("gradh")
 def pallas_ve_def_gradh(
     x, y, z, h, m, xm, sorted_keys, box: Box, const, cfg: NeighborConfig,
     ranges=None, interpret: bool = False, jdata=None, i_offset=0,
@@ -1496,6 +1503,7 @@ def pallas_ve_def_gradh(
     return (f(kx), f(gradh)), ranges.occupancy
 
 
+@named_phase("divv-curlv")
 def pallas_iad_divv_curlv(
     x, y, z, vx, vy, vz, h, kx, xm,
     c11, c12, c13, c22, c23, c33,
@@ -1620,6 +1628,7 @@ def pallas_iad_divv_curlv(
     return tuple(f(a) for a in outs), ranges.occupancy
 
 
+@named_phase("av-switches")
 def pallas_av_switches(
     x, y, z, vx, vy, vz, h, c, kx, xm, divv, alpha,
     c11, c12, c13, c22, c23, c33,
@@ -1734,6 +1743,7 @@ def pallas_av_switches(
     return alpha_new.reshape(-1)[:n], ranges.occupancy
 
 
+@named_phase("momentum-energy")
 def pallas_momentum_energy_ve(
     x, y, z, vx, vy, vz, h, m, prho, c, kx, xm, alpha,
     c11, c12, c13, c22, c23, c33,

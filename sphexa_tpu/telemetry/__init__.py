@@ -25,6 +25,11 @@ runs — or a run against a ``BENCH_r*.json`` round — with threshold-based
 exit codes. See docs/OBSERVABILITY.md for the event schema.
 """
 
+from sphexa_tpu.telemetry.flightrec import (
+    FlightRecorder,
+    RingSink,
+    read_blackbox,
+)
 from sphexa_tpu.telemetry.manifest import (
     MANIFEST_SCHEMA,
     build_manifest,
@@ -61,4 +66,7 @@ __all__ = [
     "device_memory_snapshot",
     "emit_memory_event",
     "save_memory_profile",
+    "FlightRecorder",
+    "RingSink",
+    "read_blackbox",
 ]

@@ -4,6 +4,9 @@
     sphexa-telemetry shards  <run-dir> [--format text|json]
     sphexa-telemetry science <run-dir> [--format text|json] [--budget F]
     sphexa-telemetry diff <baseline> <candidate> [--threshold F] [--drift]
+    sphexa-telemetry trace <trace-dir> [--min-coverage F] [--top N]
+    sphexa-telemetry history [inputs...] [--root DIR]
+    sphexa-telemetry regress --lock <lock.json> [candidate] [--write]
 
 ``summary`` reads ``<run-dir>/manifest.json`` + ``events.jsonl`` and
 reports p50/p95/mean step time, retrace/rollback/reconfigure counts and
@@ -39,6 +42,25 @@ comm-volume regressions directly. ``--drift`` makes run-vs-run energy
 drift a headline metric (drift-vs-drift with the same threshold exit
 codes).
 
+``trace`` is the time view (schema v4): per-phase device-time
+attribution of a ``--trace-dir`` jax.profiler capture, joined from the
+perfetto dump + the xplane sidecar's op metadata (the
+``jax.named_scope("sphexa/<phase>")`` taxonomy the step programs carry;
+telemetry/traceview.py). ``--min-coverage`` is the chip-harvest gate:
+exit 1 when less than that fraction of device-op time lands in named
+phases.
+
+``history`` renders the cross-run trend (the committed
+``BENCH_r*``/``MULTICHIP_r*`` rounds and/or run dirs) and ``regress``
+gates the committed lock file (``TELEMETRY_LOCK.json``) so a chip-less
+PR cannot regress a locked, chip-measured number (telemetry/history.py;
+exit 0 hold / 1 regressed-or-missing / 2 unreadable).
+
+Crash-truncated runs are EXPLAINED, not merely tolerated: when the
+flight recorder (telemetry/flightrec.py) left a ``blackbox.json``,
+``summary``/``science`` surface its reason, watchdog state and
+traceback tail next to the partial aggregation.
+
 Deliberately jax-free: summarizing a run must not drag in a backend.
 """
 
@@ -52,8 +74,14 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from sphexa_tpu.devtools.common import render_table
+from sphexa_tpu.telemetry.flightrec import read_blackbox
+from sphexa_tpu.telemetry.history import (
+    HistoryError,
+    parse_bench_json as _parse_bench_json,
+)
 from sphexa_tpu.telemetry.manifest import read_manifest
 from sphexa_tpu.telemetry.registry import EVENT_KINDS, validate_event
+from sphexa_tpu.telemetry.traceview import TraceError
 
 
 class TelemetryError(Exception):
@@ -93,6 +121,22 @@ def load_events(run_dir: str) -> Tuple[List[dict], List[str]]:
 
 def _of_kind(events: List[dict], kind: str) -> List[dict]:
     return [e for e in events if e.get("kind") == kind]
+
+
+def _crash_view(run_dir: str) -> Optional[Dict]:
+    """Compact blackbox digest for the summary/science views (None when
+    the run has no flight-recorder dump)."""
+    box = read_blackbox(run_dir)
+    if box is None:
+        return None
+    tb = (box.get("traceback") or "").strip().splitlines()
+    return {
+        "reason": box.get("reason"),
+        "watchdogs": box.get("watchdogs") or {},
+        "buffered_events": len(box.get("events") or []),
+        "traceback_tail": tb[-3:],
+        "fault_log": box.get("fault_log"),
+    }
 
 
 def summarize_run(run_dir: str) -> Dict:
@@ -166,6 +210,9 @@ def summarize_run(run_dir: str) -> Dict:
             phases.items())},
         "unknown_kinds": {str(k): int(n)
                           for k, n in sorted(unknown_kinds.items())},
+        # the flight recorder's dump, when the run died abnormally: the
+        # summary EXPLAINS a truncated record instead of tolerating it
+        "crash": _crash_view(run_dir),
         "schema_problems": problems,
     }
 
@@ -337,39 +384,23 @@ def summarize_science(run_dir: str) -> Dict:
         "extrema": extrema_rows,
         "drift_events": len(_of_kind(events, "drift")),
         "field_health_events": len(_of_kind(events, "field_health")),
+        "crash": _crash_view(run_dir),
         "schema_problems": problems,
     }
 
 
-def _parse_bench_json(path: str) -> Dict:
-    """bench.py's JSON line, or a driver wrapper (``BENCH_r*.json`` /
-    ``MULTICHIP_r*.json``) whose ``tail`` buries a metric/value line in
-    captured output (measure_multichip.py --json emits the same shape,
-    so multi-chip comm-volume rounds diff exactly like bench rounds)."""
-    with open(path) as f:
-        data = json.load(f)
-    if "metric" in data and "value" in data:
-        return data
-    if "tail" in data:
-        for line in reversed(str(data["tail"]).splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    inner = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if "metric" in inner and "value" in inner:
-                    return inner
-    raise TelemetryError(f"{path}: not a bench JSON (no metric/value line)")
-
-
 def load_side(path: str) -> Dict:
-    """One diff operand: a telemetry run dir or a bench JSON file."""
+    """One diff operand: a telemetry run dir or a bench JSON file
+    (parsing shared with the history/regress machinery —
+    telemetry/history.parse_bench_json owns the wrapper shapes)."""
     if os.path.isdir(path):
         s = summarize_run(path)
         return {"type": "run", "label": path, "summary": s}
     if os.path.isfile(path):
-        b = _parse_bench_json(path)
+        try:
+            b = _parse_bench_json(path)
+        except HistoryError as e:
+            raise TelemetryError(str(e))
         return {"type": "bench", "label": path, "bench": b}
     raise TelemetryError(f"{path}: neither a run directory nor a file")
 
@@ -539,12 +570,31 @@ def render_summary(s: Dict) -> str:
     if s.get("imbalances"):
         rows.append(("imbalance events", s["imbalances"]))
     lines.append(render_table(rows))
+    lines.extend(_render_crash(s.get("crash")))
     for kind, n in s.get("unknown_kinds", {}).items():
         lines.append(f"  unknown kind: {kind} x{n} (newer writer? "
                      f"upgrade this reader)")
     for p in s["schema_problems"]:
         lines.append(f"  schema: {p}")
     return "\n".join(lines)
+
+
+def _render_crash(crash: Optional[Dict]) -> List[str]:
+    """Lines explaining a flight-recorder dump (empty for clean runs)."""
+    if not crash:
+        return []
+    lines = [f"CRASH: {crash.get('reason', '?')} (blackbox.json, "
+             f"{crash.get('buffered_events', 0)} buffered events)"]
+    hot = {k: v for k, v in (crash.get("watchdogs") or {}).items()
+           if v and k != "events_total"}
+    if hot:
+        lines.append("  watchdog state at death: "
+                     + " ".join(f"{k}={v}" for k, v in sorted(hot.items())))
+    for t in crash.get("traceback_tail") or []:
+        lines.append(f"  | {t}")
+    if crash.get("fault_log"):
+        lines.append(f"  fault log: {crash['fault_log']}")
+    return lines
 
 
 def _fmt_bytes(v) -> str:
@@ -636,6 +686,7 @@ def render_science(s: Dict) -> str:
         lines.append("  no physics telemetry in this run "
                      "(pre-v3 writer, or it crashed before the first "
                      "check/flush boundary)")
+        lines.extend(_render_crash(s.get("crash")))
         return "\n".join(lines)
     d = s.get("drift") or {}
     rows = [
@@ -680,6 +731,7 @@ def render_science(s: Dict) -> str:
                            "|du| max", "nc clip", "h sat")))
         if len(ext) > 12:
             lines.append(f"  ({len(ext) - 12} middle windows elided)")
+    lines.extend(_render_crash(s.get("crash")))
     for p in s["schema_problems"]:
         lines.append(f"  schema: {p}")
     return "\n".join(lines)
@@ -743,6 +795,47 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run-vs-run: make energy drift a headline "
                          "metric (conservation regression gate)")
     pd.add_argument("--format", choices=("text", "json"), default="text")
+    pt = sub.add_parser(
+        "trace",
+        help="per-phase device-time attribution of a --trace-dir "
+             "jax.profiler capture (the sphexa/<phase> named scopes)")
+    pt.add_argument("trace_dir")
+    pt.add_argument("--format", choices=("text", "json"), default="text")
+    pt.add_argument("--min-coverage", type=float, default=None,
+                    dest="min_coverage",
+                    help="exit 1 when less than this fraction of "
+                         "device-op time is attributed to sphexa/ "
+                         "phases (the chip-harvest gate)")
+    pt.add_argument("--top", type=int, default=8,
+                    help="unattributed ops to list [8]")
+    ph2 = sub.add_parser(
+        "history",
+        help="cross-run trend over BENCH_r*/MULTICHIP_r* rounds and "
+             "run dirs")
+    ph2.add_argument("inputs", nargs="*",
+                     help="bench JSONs / run dirs (default: the "
+                          "committed rounds under --root)")
+    ph2.add_argument("--root", default=".",
+                     help="where the committed round files live [.]")
+    ph2.add_argument("--format", choices=("text", "json"), default="text")
+    pr = sub.add_parser(
+        "regress",
+        help="gate the committed lock file: exit 1 when any locked, "
+             "chip-measured metric regressed (or cannot be read)")
+    pr.add_argument("candidate", nargs="?", default=None,
+                    help="optional fresh bench JSON to check EVERY "
+                         "locked metric against (pre-commit gate of a "
+                         "new measurement); default: each metric's "
+                         "committed source file")
+    pr.add_argument("--lock", required=True,
+                    help="lock file (TELEMETRY_LOCK.json)")
+    pr.add_argument("--root", default=None,
+                    help="base dir for the lock's source files "
+                         "[the lock file's directory]")
+    pr.add_argument("--write", action="store_true",
+                    help="re-read every source and overwrite the locked "
+                         "values (the harvest-day locking step)")
+    pr.add_argument("--format", choices=("text", "json"), default="text")
     return p
 
 
@@ -773,12 +866,71 @@ def main(argv=None) -> int:
                 return 1 if dmax is None or dmax > args.budget else 0
             return 1 if (s["drift_events"]
                          or s["field_health_events"]) else 0
+        if args.cmd == "trace":
+            from sphexa_tpu.telemetry.traceview import (
+                render_trace,
+                summarize_trace,
+            )
+
+            s = summarize_trace(args.trace_dir, top=args.top)
+            print(json.dumps(s, indent=2) if args.format == "json"
+                  else render_trace(s))
+            if not s["phases"]:
+                return 1  # an unattributed capture must not pass green
+            if args.min_coverage is not None \
+                    and s["coverage"] < args.min_coverage:
+                print(f"sphexa-telemetry: coverage {s['coverage']:.1%} "
+                      f"below --min-coverage {args.min_coverage:.1%}",
+                      file=sys.stderr)
+                return 1
+            return 0
+        if args.cmd == "history":
+            from sphexa_tpu.telemetry.history import (
+                default_inputs,
+                load_history,
+                render_history,
+            )
+
+            inputs = args.inputs or default_inputs(args.root)
+            rows = load_history(inputs)
+            print(json.dumps(rows, indent=2) if args.format == "json"
+                  else render_history(rows))
+            return 0 if rows else 1
+        if args.cmd == "regress":
+            from sphexa_tpu.telemetry.history import (
+                evaluate_lock,
+                load_lock,
+                render_regress,
+                write_lock,
+            )
+
+            lock = load_lock(args.lock)
+            root = args.root if args.root is not None \
+                else (os.path.dirname(os.path.abspath(args.lock)) or ".")
+            if args.write:
+                if args.candidate:
+                    # --write re-reads the COMMITTED sources; accepting a
+                    # candidate here would silently relock stale numbers
+                    # while the user believes the fresh file was locked
+                    raise TelemetryError(
+                        "--write relocks from the committed sources and "
+                        "ignores a candidate: gate the fresh file first "
+                        "(regress --lock L <candidate>), commit it, point "
+                        "the lock's sources at it, then --write")
+                lock = write_lock(args.lock, lock, root)
+                print(f"locked {len(lock['metrics'])} metrics -> "
+                      f"{args.lock}")
+                return 0
+            res = evaluate_lock(lock, root, candidate=args.candidate)
+            print(json.dumps(res, indent=2) if args.format == "json"
+                  else render_regress(res))
+            return 1 if res["regressed"] else 0
         d = diff_sides(load_side(args.baseline), load_side(args.candidate),
                        args.threshold, drift=args.drift)
         print(json.dumps(d, indent=2) if args.format == "json"
               else render_diff(d))
         return 1 if d["regressed"] else 0
-    except TelemetryError as e:
+    except (TelemetryError, TraceError, HistoryError) as e:
         print(f"sphexa-telemetry: {e}", file=sys.stderr)
         return 2
     except (OSError, json.JSONDecodeError) as e:

@@ -23,13 +23,13 @@ import numpy as np
 #: document the migration in docs/OBSERVABILITY.md. v2 added the
 #: distributed kinds (exchange / shard_load / memory / imbalance), v3
 #: the physics-observability kinds (physics / numerics / drift /
-#: field_health); neither changed the older kinds, so v3 readers accept
-#: v1 and v2 files.
-SCHEMA_VERSION = 3
+#: field_health), v4 the time-and-history kinds (phase_attr / crash);
+#: none changed the older kinds, so v4 readers accept v1-v3 files.
+SCHEMA_VERSION = 4
 
 #: event schema versions this reader understands (older versions only
 #: ever ADD kinds, so the per-kind field table below covers them all)
-SUPPORTED_VERSIONS = (1, 2, 3)
+SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 #: every event kind the schema admits, with its required payload fields
 #: (beyond the envelope ``v``/``seq``/``t``/``kind``). The CLI's --strict
@@ -75,14 +75,25 @@ EVENT_KINDS: Dict[str, tuple] = {
     # field-health watchdog: nonfinite rho/h/du values appeared in a
     # verified step (localize with --debug-checks)
     "field_health": ("it", "nonfinite"),
+    # -- v4: time-and-history kinds (profiler attribution + crash) --------
+    # per-phase device-time attribution of a --trace-dir capture
+    # (telemetry/traceview.py over the jax.profiler dump): ``phases`` =
+    # {"<phase>": device_us}, plus coverage/total_device_us/dir context
+    "phase_attr": ("phases",),
+    # crash flight recorder (telemetry/flightrec.py): appended by the
+    # abnormal-exit hooks alongside blackbox.json so the event stream
+    # itself records WHY it ends mid-run
+    "crash": ("reason",),
 }
 
 #: first schema version each kind appeared in (an older-versioned event
 #: carrying a newer kind is writer confusion, not forward compatibility)
 _V2_ONLY = frozenset({"exchange", "shard_load", "memory", "imbalance"})
 _V3_ONLY = frozenset({"physics", "numerics", "drift", "field_health"})
+_V4_ONLY = frozenset({"phase_attr", "crash"})
 KIND_SINCE: Dict[str, int] = {
-    k: 3 if k in _V3_ONLY else 2 if k in _V2_ONLY else 1
+    k: 4 if k in _V4_ONLY else 3 if k in _V3_ONLY
+    else 2 if k in _V2_ONLY else 1
     for k in EVENT_KINDS
 }
 

@@ -356,3 +356,31 @@ class TestPrimordialNetwork:
                                    rtol=1e-6)
         # metal lines dominate the band between the H/He peak and brems
         assert at(2e5, z_sun) > at(2e7, z_sun)
+
+    def test_metal_channel_uses_config_hydrogen_fraction(self):
+        """ADVICE round-5 regression: metal_cooling24 used to hard-code
+        x_h=0.76, so a non-default composition got the WRONG n_H^2
+        conversion of the table rate. The default must now track
+        cfg.hydrogen_fraction exactly (explicit x_h still wins)."""
+        import dataclasses
+
+        import numpy as np
+
+        from sphexa_tpu.physics import primordial as pn
+        from sphexa_tpu.physics.cooling import CoolingConfig
+
+        base = self._cfg()
+        lean = dataclasses.replace(base, hydrogen_fraction=0.6)
+        assert isinstance(lean, CoolingConfig)
+        T, z = np.float64(2e5), np.float64(0.0122)
+        # default == explicit cfg fraction, for BOTH compositions
+        np.testing.assert_allclose(
+            float(pn.metal_cooling24(T, z, lean)),
+            float(pn.metal_cooling24(T, z, lean, x_h=0.6)), rtol=0)
+        np.testing.assert_allclose(
+            float(pn.metal_cooling24(T, z, base)),
+            float(pn.metal_cooling24(T, z, base,
+                                     x_h=base.hydrogen_fraction)), rtol=0)
+        # and a leaner composition is NOT the 0.76 number (the old bug)
+        assert float(pn.metal_cooling24(T, z, lean)) != float(
+            pn.metal_cooling24(T, z, lean, x_h=0.76))

@@ -207,15 +207,17 @@ class TestSimulationTelemetry:
         """A deferred-detected overflow must surface as first-class
         rollback/replay telemetry (it used to be visible only as
         ``reconfigured`` on one diagnostics dict), and the forced
-        reconfigure's fresh compile must trip the retrace watchdog.
+        reconfigure must trip the retrace watchdog.
 
-        side 14 deliberately: the retrace assertion needs this test's
-        executables to be UNIQUE in the process — test_simulation_async
-        doctors the identical sedov(12)/block-4096/cap-8 config, and
-        with the global jit caches pre-warmed by it (alphabetical suite
-        order) every launch here would see a zero cache delta and the
-        watchdog would correctly report nothing."""
-        state, box, const = init_sedov(14)
+        side 12 DELIBERATELY collides with test_simulation_async's
+        doctored sedov(12)/block-4096/cap-8 config: under alphabetical
+        suite order the global jit caches arrive pre-warmed, the cache
+        delta is zero, and the old cache-size-only watchdog reported
+        nothing (the order-dependent failure this pins). The watchdog
+        now baselines executable signatures PER Simulation
+        (_launch_signature), so this run's launches under a config it
+        never used count as retraces — warm cache or not."""
+        state, box, const = init_sedov(12)
         sink = MemorySink()
         from sphexa_tpu.observables import ObservableSpec
 
@@ -650,10 +652,11 @@ class TestCli:
         s = json.loads(capsys.readouterr().out)
         assert s["unknown_kinds"] == {"from_the_future": 2}
 
-    def test_v1_v2_files_validate_under_v3_reader(self, tmp_path, capsys):
-        """The version-compat contract: files written by the v1 and v2
+    def test_v1_v2_v3_files_validate_under_v4_reader(self, tmp_path,
+                                                     capsys):
+        """The version-compat contract: files written by the v1-v3
         schemas (older envelopes, their own kinds) summarize strictly
-        clean under this v3 reader; a newer-only kind claiming an older
+        clean under this v4 reader; a newer-only kind claiming an older
         version is flagged."""
         d = tmp_path / "v1run"
         d.mkdir()
@@ -662,21 +665,35 @@ class TestCli:
                     '"wall_s":0.1}\n')
             f.write('{"v":1,"seq":1,"t":1.0,"kind":"retrace","it":1,'
                     '"delta":1}\n')
-            # v2 envelope with a v2 kind: valid under the v3 reader
+            # v2 envelope with a v2 kind: valid under the v4 reader
             f.write('{"v":2,"seq":2,"t":1.0,"kind":"exchange","it":1,'
                     '"shipped_rows":1,"rows":[1]}\n')
+            # v3 envelope with a v3 kind: valid too
+            f.write('{"v":3,"seq":3,"t":1.0,"kind":"physics","it":1,'
+                    '"etot":[1.0]}\n')
+            # v4 kinds on a v4 envelope: valid
+            f.write('{"v":4,"seq":4,"t":1.0,"kind":"phase_attr",'
+                    '"phases":{"density":10.0},"coverage":0.9}\n')
+            f.write('{"v":4,"seq":5,"t":1.0,"kind":"crash",'
+                    '"reason":"signal SIGTERM"}\n')
         assert cli_main(["summary", str(d), "--strict"]) == 0
         capsys.readouterr()
         with open(d / "events.jsonl", "a") as f:
-            f.write('{"v":1,"seq":3,"t":1.0,"kind":"exchange","it":2,'
+            f.write('{"v":1,"seq":6,"t":1.0,"kind":"exchange","it":2,'
                     '"shipped_rows":1,"rows":[1]}\n')
         assert cli_main(["summary", str(d), "--strict"]) == 1
         assert "v2-only kind" in capsys.readouterr().out
         with open(d / "events.jsonl", "a") as f:
-            f.write('{"v":2,"seq":4,"t":1.0,"kind":"physics","it":3,'
+            f.write('{"v":2,"seq":7,"t":1.0,"kind":"physics","it":3,'
                     '"etot":[1.0]}\n')
         assert cli_main(["summary", str(d), "--strict"]) == 1
         assert "v3-only kind" in capsys.readouterr().out
+        # a v4-only kind claiming a v3 envelope is writer confusion
+        with open(d / "events.jsonl", "a") as f:
+            f.write('{"v":3,"seq":8,"t":1.0,"kind":"crash",'
+                    '"reason":"x"}\n')
+        assert cli_main(["summary", str(d), "--strict"]) == 1
+        assert "v4-only kind" in capsys.readouterr().out
 
     def _make_shard_run(self, tmp_path):
         d = tmp_path / "mesh"
@@ -844,6 +861,8 @@ class TestCli:
         assert "--drift" in capsys.readouterr().err
 
     def test_app_writes_manifest_and_events(self, tmp_path):
+        import os
+
         from sphexa_tpu.app.main import main as app_main
         from sphexa_tpu.telemetry.cli import summarize_run
 
@@ -860,3 +879,186 @@ class TestCli:
         assert cli_main(["summary", tdir, "--strict"]) == 0
         # the in-graph ledger made it into the record: science renders
         assert cli_main(["science", tdir]) == 0
+        # clean exit: the flight recorder disarmed, no blackbox written
+        assert not os.path.exists(os.path.join(tdir, "blackbox.json"))
+        assert s["crash"] is None
+
+
+# ---------------------------------------------------------------------------
+# cross-run history + the regression lock (schema v4 CLI)
+# ---------------------------------------------------------------------------
+
+
+class TestHistoryAndRegress:
+    def _bench_file(self, tmp_path, name, value, ve=None, wrapped=False,
+                    extra=None):
+        line = {"metric": "particle-updates/sec/chip", "value": value,
+                "unit": "particles/s", "vs_baseline": value / 2e7,
+                "extra": dict(extra or {})}
+        if ve is not None:
+            line["extra"]["ve_updates_per_sec"] = ve
+        p = tmp_path / name
+        if wrapped:
+            p.write_text(json.dumps(
+                {"n": 5, "rc": 0, "tail": "noise\n" + json.dumps(line)}))
+        else:
+            p.write_text(json.dumps(line))
+        return str(p)
+
+    def test_history_renders_rounds_and_trend(self, tmp_path, capsys):
+        self._bench_file(tmp_path, "BENCH_r01.json", 1.0e6, wrapped=True)
+        self._bench_file(tmp_path, "BENCH_r02.json", 2.0e6, ve=1.5e6)
+        # a committed skipped round keeps its row instead of erroring
+        (tmp_path / "MULTICHIP_r01.json").write_text(json.dumps(
+            {"n_devices": 8, "rc": 0, "ok": True, "tail": "dry run"}))
+        assert cli_main(["history", "--root", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "r01" in out and "r02" in out
+        assert "+100.0%" in out  # 1.0 -> 2.0 M/s between rounds
+        assert "dry-run ok" in out
+        assert "bench trajectory" in out
+        assert cli_main(["history", "--root", str(tmp_path),
+                         "--format", "json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert [r["round"] for r in rows] == [1, 2, 1]
+        assert rows[1]["change"] == pytest.approx(1.0)
+        # empty root: nothing to trend is exit 1, not a fake table
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert cli_main(["history", "--root", str(empty)]) == 1
+        capsys.readouterr()
+        # unreadable input is a usage error
+        assert cli_main(["history", str(tmp_path / "nope.json")]) == 2
+        # an explicit input that is valid JSON but NOT a bench/wrapper
+        # file (a manifest, the lock itself, a typo) must exit 2 too,
+        # not fabricate a value-less row
+        stray = tmp_path / "manifest.json"
+        stray.write_text(json.dumps({"schema": 1, "particles": 64}))
+        assert cli_main(["history", str(stray)]) == 2
+        # a round-NAMED file with non-dict JSON is corrupt, not a dry
+        # run: exit 2, no traceback
+        corrupt = tmp_path / "BENCH_r09.json"
+        corrupt.write_text("[1, 2]")
+        assert cli_main(["history", str(corrupt)]) == 2
+
+    def _lock_file(self, tmp_path, value, source="BENCH_r05.json",
+                   field="value", threshold=0.05):
+        lock = {"schema": 1, "metrics": [
+            {"name": "std_updates_per_sec", "source": source,
+             "field": field, "value": value, "threshold": threshold,
+             "higher_is_better": True}]}
+        p = tmp_path / "LOCK.json"
+        p.write_text(json.dumps(lock))
+        return str(p)
+
+    def test_regress_exit_codes(self, tmp_path, capsys):
+        self._bench_file(tmp_path, "BENCH_r05.json", 3.5e6, wrapped=True)
+        # holding: committed value matches the lock
+        lock = self._lock_file(tmp_path, 3.5e6)
+        assert cli_main(["regress", "--lock", lock]) == 0
+        assert "all locked metrics hold" in capsys.readouterr().out
+        # a doctored lock claiming a higher chip number fails the gate
+        lock = self._lock_file(tmp_path, 4.2e6)
+        assert cli_main(["regress", "--lock", lock]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSED" in out and "regression vs lock" in out
+        # within threshold: 3% below a 5% budget still holds
+        lock = self._lock_file(tmp_path, 3.6e6)
+        assert cli_main(["regress", "--lock", lock]) == 0
+        capsys.readouterr()
+        # a missing source/field must FAIL, not silently pass
+        lock = self._lock_file(tmp_path, 3.5e6, source="GONE.json")
+        assert cli_main(["regress", "--lock", lock]) == 1
+        assert "problem:" in capsys.readouterr().out
+        lock = self._lock_file(tmp_path, 3.5e6, field="extra.nope")
+        assert cli_main(["regress", "--lock", lock]) == 1
+        capsys.readouterr()
+        # unreadable lock file is a usage error
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert cli_main(["regress", "--lock", str(bad)]) == 2
+
+    def test_regress_candidate_and_write(self, tmp_path, capsys):
+        """The harvest-day workflow: gate a FRESH measurement against
+        the lock before committing it, then --write to lock it in."""
+        self._bench_file(tmp_path, "BENCH_r05.json", 3.5e6, wrapped=True)
+        lock = self._lock_file(tmp_path, 3.5e6)
+        good = self._bench_file(tmp_path, "fresh.json", 3.8e6)
+        worse = self._bench_file(tmp_path, "slow.json", 3.0e6)
+        assert cli_main(["regress", "--lock", lock, good]) == 0
+        capsys.readouterr()
+        assert cli_main(["regress", "--lock", lock, worse]) == 1
+        capsys.readouterr()
+        # --write + candidate is a usage error: it would silently relock
+        # the stale committed values, not the fresh file
+        assert cli_main(["regress", "--lock", lock, good, "--write"]) == 2
+        capsys.readouterr()
+        # --write re-reads the committed source and locks its value
+        self._bench_file(tmp_path, "BENCH_r05.json", 3.9e6, wrapped=True)
+        assert cli_main(["regress", "--lock", lock, "--write"]) == 0
+        capsys.readouterr()
+        locked = json.loads(open(lock).read())
+        assert locked["metrics"][0]["value"] == pytest.approx(3.9e6)
+        assert cli_main(["regress", "--lock", lock]) == 0
+
+    def test_regress_candidate_gates_matching_kind_only(self, tmp_path,
+                                                        capsys):
+        """A candidate measures ONE kind: its metrics are gated, the
+        other kind's locked metrics are skipped (a fresh BENCH says
+        nothing about the multichip saving — comparing a throughput
+        against a saving ratio was a nonsense verdict either way), and
+        a candidate matching NO locked metric fails."""
+        self._bench_file(tmp_path, "BENCH_r05.json", 3.5e6, wrapped=True)
+        lock = {"schema": 1, "metrics": [
+            {"name": "std_updates_per_sec", "source": "BENCH_r05.json",
+             "field": "value", "value": 3.5e6, "threshold": 0.05},
+            {"name": "multichip_sparse_saving",
+             "source": "MULTICHIP_BASELINE.json", "field": "value",
+             "value": 1.25, "threshold": 0.05}]}
+        lp = tmp_path / "LOCK.json"
+        lp.write_text(json.dumps(lock))
+        # bench candidate: throughput gated, the saving skipped — worse
+        # throughput still fails, a BETTER one passes even though 3.8e6
+        # vs the locked 1.25 saving would be nonsense
+        good = self._bench_file(tmp_path, "fresh.json", 3.8e6)
+        assert cli_main(["regress", "--lock", str(lp), good]) == 0
+        out = capsys.readouterr().out
+        assert "skipped" in out and "REGRESSED" not in out
+        worse = self._bench_file(tmp_path, "slow.json", 3.0e6)
+        assert cli_main(["regress", "--lock", str(lp), worse]) == 1
+        capsys.readouterr()
+        # multichip candidate: only the saving is gated (a fresh saving
+        # of 1.3 vs the locked bench 3.5e6 must NOT read as regressed)
+        mc = tmp_path / "MULTICHIP_fresh.json"
+        mc.write_text(json.dumps(
+            {"metric": "sparse saving", "value": 1.3, "unit": "x"}))
+        assert cli_main(["regress", "--lock", str(lp), str(mc)]) == 0
+        out = capsys.readouterr().out
+        assert out.count("skipped") == 1 and "ok" in out
+        # a candidate whose kind matches no locked metric gated nothing
+        lock["metrics"] = lock["metrics"][:1]  # bench-only lock
+        lp.write_text(json.dumps(lock))
+        assert cli_main(["regress", "--lock", str(lp), str(mc)]) == 1
+        assert "nothing was gated" in capsys.readouterr().out
+        # a multichip source NOT named MULTICHIP_* classifies by its
+        # CONTENT (saving metric), so a bench candidate skips it
+        (tmp_path / "chip_saving.json").write_text(json.dumps(
+            {"metric": "sparse-exchange saving", "value": 1.25,
+             "unit": "x"}))
+        lock["metrics"] = [
+            {"name": "saving", "source": "chip_saving.json",
+             "field": "value", "value": 1.25, "threshold": 0.05}]
+        lp.write_text(json.dumps(lock))
+        assert cli_main(["regress", "--lock", str(lp), "--root",
+                         str(tmp_path), good]) == 1  # skipped -> nothing gated
+        assert "nothing was gated" in capsys.readouterr().out
+
+    def test_committed_lock_holds(self, capsys):
+        """The repo's own TELEMETRY_LOCK.json must gate green against
+        the committed round files — the check.sh contract."""
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        lock = os.path.join(root, "TELEMETRY_LOCK.json")
+        assert cli_main(["regress", "--lock", lock]) == 0
+        assert "all locked metrics hold" in capsys.readouterr().out
