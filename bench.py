@@ -168,10 +168,19 @@ def main() -> int:
     n = SIDE**3
     state, box, const = init_sedov(SIDE)
     # deferred cap-checking: the happy path issues no device->host sync
-    # per step (diagnostics checked in one batch at the window end)
+    # per step (diagnostics checked in one batch at the window end).
+    # BENCH_TUNED ("auto" or a table path) routes the non-explicit knobs
+    # through the committed tuning table; either way the resolved
+    # provenance is stamped into extra.tuning below, so history/diff can
+    # attribute a throughput change to a knob change.
+    tuned = os.environ.get("BENCH_TUNED") or None
     sim = Simulation(state, box, const, prop="std", block=8192,
                      check_every=STEPS, telemetry=tel,
-                     obs_spec=ObservableSpec())
+                     obs_spec=ObservableSpec(),
+                     tuned=tuned, workload="sedov")
+    tuning_stamp = {k: v for k, v in sim.tuning_provenance.items()
+                    if k in ("source", "key", "knobs", "explicit")
+                    and v not in (None, [], {})}
     # BENCH_TRACE_DIR: capture a jax.profiler trace of the headline
     # window and stamp its per-phase attribution into the JSON — the
     # chip-harvest workflow (docs/NEXT.md round 8: every bench round
@@ -201,6 +210,9 @@ def main() -> int:
         return 1
 
     extra = {}
+    # how the headline run's knobs were chosen (heuristic, or a table
+    # entry's key) — existing keys stay byte-compatible, this only adds
+    extra["tuning"] = tuning_stamp
     if phase_attr is not None:
         extra["phase_attr"] = phase_attr
     # conservation health of the benched run, free from the in-graph

@@ -3,11 +3,12 @@
 # history/regression lock -> tier-1 tests — what CI (and a pre-push
 # hook) runs.
 #
-#   scripts/check.sh                  # lint + audit + telemetry + history + fast tier
+#   scripts/check.sh                  # lint + audit + telemetry + history + tuning + fast tier
 #   scripts/check.sh --lint-only
 #   scripts/check.sh --audit-only
 #   scripts/check.sh --telemetry-only
 #   scripts/check.sh --history-only
+#   scripts/check.sh --tuning-only
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -184,6 +185,68 @@ EOF
     rm -rf "$tmp"
 }
 
+run_tuning() {
+    echo "== tuning gate (table validation, CPU micro-sweep, table consumption) =="
+    local tmp rc
+    # the COMMITTED table must stay schema- and registry-valid: a knob
+    # rename that strands TUNING_TABLE.json entries fails HERE (exit 1)
+    env JAX_PLATFORMS=cpu python -m sphexa_tpu.telemetry tuning \
+        TUNING_TABLE.json
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "sphexa-telemetry tuning failed on the committed table"
+        echo "(rc=$rc): stale knob names or schema drift — re-sweep or"
+        echo "fix TUNING_TABLE.json (docs/TUNING.md)."
+        exit $rc
+    fi
+    # close the observe->decide loop on CPU: a 2-candidate micro-sweep
+    # over a tiny sedov must complete, commit its winner to a scratch
+    # table, and every candidate must land as a strict-valid v5 sweep
+    # event in the sweep run's events.jsonl
+    tmp=$(mktemp -d)
+    env JAX_PLATFORMS=cpu python -m sphexa_tpu.tuning.cli \
+        --case sedov --side 5 --backend xla --knobs gap --budget 2 \
+        --steps 2 --warmup 1 --quiet --commit best \
+        --out "$tmp/sweep" --write-table "$tmp/table.json"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "sphexa-tune micro-sweep failed (rc=$rc): no candidate"
+        echo "measured cleanly (sphexa_tpu/tuning/replay.py)."
+        rm -rf "$tmp"
+        exit $rc
+    fi
+    python -m sphexa_tpu.telemetry summary "$tmp/sweep" --strict
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "strict validation failed on the sweep run (rc=$rc): the"
+        echo "autotuner emitted schema-invalid sweep/tuning events."
+        rm -rf "$tmp"
+        exit $rc
+    fi
+    # the replay harness's output must be CONSUMABLE: a Simulation built
+    # with tuned=<table> must resolve its knobs from the entry we just
+    # committed (provenance source == "table")
+    env JAX_PLATFORMS=cpu python - "$tmp/table.json" <<'EOF'
+import sys
+from sphexa_tpu.init import make_initializer
+from sphexa_tpu.simulation import Simulation
+state, box, const = make_initializer("sedov")(5)
+sim = Simulation(state, box, const, backend="xla",
+                 tuned=sys.argv[1], workload="sedov")
+prov = sim.tuning_provenance
+assert prov["source"] == "table", prov
+assert prov["knobs"], prov
+EOF
+    rc=$?
+    rm -rf "$tmp"
+    if [ $rc -ne 0 ]; then
+        echo "tuned=<table> consumption failed (rc=$rc): Simulation did"
+        echo "not resolve knobs from the freshly committed entry"
+        echo "(sphexa_tpu/tuning/table.py resolve_knobs)."
+        exit $rc
+    fi
+}
+
 run_multichip_diff() {
     echo "== multi-chip comm-volume gate (measure_multichip --quick vs baseline) =="
     local tmp rc
@@ -229,12 +292,17 @@ case "${1:-}" in
         run_history
         exit 0
         ;;
+    --tuning-only)
+        run_tuning
+        exit 0
+        ;;
 esac
 
 run_lint
 run_audit
 run_telemetry
 run_history
+run_tuning
 run_multichip_diff
 
 echo "== tier-1 tests (fast tier, CPU) =="

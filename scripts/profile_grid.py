@@ -1,114 +1,30 @@
-"""Grid sweep of the engine's static config: time the FULL fused std
-pipeline (sort+prologue+density+iad+momentum) per config, with warmup
-(first post-compile batch is a ~1.5x outlier on axon) and min-of-3.
+"""Grid-level sweep of the engine's static config (the cell_target /
+gap interaction: finer grids fragment runs, aggressive bridging heals
+them) — now a thin wrapper over the autotuner's replay harness
+(sphexa_tpu/tuning). The harness times the full stepped pipeline with
+the sync-free window clock (warmup window absorbs the post-compile
+outlier the old min-of-3 loop existed for), emits a schema-v5 ``sweep``
+event per candidate into <out>/events.jsonl, and exits nonzero when no
+candidate measures cleanly. The hand-rolled jit pipeline + perf_counter
+core this script used to duplicate with sweep_engine.py is gone.
 
-Usage: [PROF_SIDE=100] python scripts/profile_grid.py
+Usage: [PROF_SIDE=100] [SWEEP_BUDGET=12] python scripts/profile_grid.py
+       [sweep-out-dir]
 """
 
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import dataclasses
-
-import jax
-import jax.numpy as jnp
-
-from sphexa_tpu.init import init_sedov
-from sphexa_tpu.simulation import Simulation, make_propagator_config
-from sphexa_tpu.sfc.box import make_global_box
-from sphexa_tpu.sfc.keys import compute_sfc_keys
-from sphexa_tpu.propagator import _sort_by_keys
-from sphexa_tpu.sph import hydro_std
-from sphexa_tpu.sph import pallas_pairs as pp
-
-SIDE = int(os.environ.get("PROF_SIDE", "100"))
-ITERS = 3
-
-
-def time_config(state, box, const, n, **kw):
-    group = kw.pop("group", 64)
-    cfg = make_propagator_config(
-        state, box, const, block=8192, backend="pallas", group=group, **kw)
-    nbr = cfg.nbr
-
-    @jax.jit
-    def pipe(x, y, z, h, m, temp, vx, vy, vz):
-        keys = jnp.sort(compute_sfc_keys(x, y, z, box))
-        ranges = pp.group_cell_ranges(x, y, z, h, keys, box, nbr)
-        rho, nc, occ = pp.pallas_density(
-            x, y, z, h, m, keys, box, const, nbr, ranges=ranges)
-        p, c = hydro_std.compute_eos_std(temp, rho, const)
-        cs, _ = pp.pallas_iad(
-            x, y, z, h, m / rho, keys, box, const, nbr, ranges=ranges)
-        out = pp.pallas_momentum_energy_std(
-            x, y, z, vx, vy, vz, h, m, rho, p, c, *cs,
-            keys, box, const, nbr, ranges=ranges)
-        return out[0], occ, ranges.ncells, ranges.starts, ranges.lens
-
-    args = (state.x, state.y, state.z, state.h, state.m, state.temp,
-            state.vx, state.vy, state.vz)
-    out = pipe(*args)
-    jax.block_until_ready(out)
-    occ = int(out[1])
-    tag = (f"ct={kw.get('cell_target', 128):4d} g={group:3d} "
-           f"rc={kw.get('run_cap', 1536):4d} gap={kw.get('gap', 384):3d} "
-           f"lvl={nbr.level} cap={nbr.cap} win={nbr.window}")
-    if occ > nbr.cap:
-        print(f"{tag}  OVERFLOW occ={occ}", flush=True)
-        return
-    # warmup batches
-    for _ in range(2):
-        out = pipe(*args)
-        jax.block_until_ready(out)
-        _ = float(jnp.sum(out[0]))
-    best = 1e9
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(ITERS):
-            out = pipe(*args)
-        jax.block_until_ready(out)
-        _ = float(jnp.sum(out[0]))
-        best = min(best, (time.perf_counter() - t0) / ITERS)
-    nrun = float(jnp.mean(out[2].astype(jnp.float32)))
-    # streamed 128-lane chunk slots per target
-    lanes = float(jnp.sum(
-        jnp.ceil((out[3] % 128 + out[4]) / 128.0) * 128)) * group / n
-    print(f"{tag}  runs~{nrun:5.1f} lanes/tgt~{lanes:6.0f} "
-          f"{best*1e3:8.2f} ms  {n/best/1e6:.2f}M/s", flush=True)
-
-
-def main():
-    state, box, const = init_sedov(SIDE)
-    sim = Simulation(state, box, const, prop="std", block=8192)
-    for _ in range(2):
-        sim.step()
-    state, box = sim.state, sim.box
-    box = make_global_box(state.x, state.y, state.z, box)
-    state, _, _ = _sort_by_keys(state, box, "hilbert")
-    n = state.n
-
-    configs = [
-        # baseline
-        dict(cell_target=128, group=64, run_cap=1536, gap=384),
-        # level-5 grid (ct=32 -> finer cells), gap swept: short runs at
-        # level 5 need aggressive bridging to avoid 128-lane fragmentation
-        dict(cell_target=32, group=64, run_cap=1536, gap=384),
-        dict(cell_target=32, group=32, run_cap=1024, gap=256),
-        dict(cell_target=32, group=32, run_cap=1024, gap=128),
-        dict(cell_target=32, group=32, run_cap=1536, gap=384),
-        dict(cell_target=32, group=64, run_cap=1024, gap=128),
-        # level-5, big gap: merge most of the window into ~2 runs
-        dict(cell_target=32, group=32, run_cap=2048, gap=512),
-    ]
-    for kw in configs:
-        try:
-            time_config(state, box, const, n, **kw)
-        except Exception as e:  # noqa
-            print(f"{kw} FAILED: {type(e).__name__}: {e}"[:200], flush=True)
-
+from sphexa_tpu.tuning.cli import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main([
+        "--case", "sedov",
+        "--side", os.environ.get("PROF_SIDE", "100"),
+        "--backend", "pallas",
+        "--knobs", "cell_target,gap,group",
+        "--budget", os.environ.get("SWEEP_BUDGET", "12"),
+        "--steps", "3", "--warmup", "1",
+        "--out", sys.argv[1] if len(sys.argv) > 1 else "profile-grid-out",
+        "--format", "json",
+    ]))

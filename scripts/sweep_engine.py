@@ -1,94 +1,33 @@
-"""Sweep the pallas engine's static config on the current device
-(cell_target x run_cap x gap x group) and report per-op times.
+"""Sweep the pallas engine's static config (cell_target x run_cap x
+gap x group) on the current device — now a thin wrapper over the
+autotuner's replay harness (sphexa_tpu/tuning), so the sweep times the
+REAL stepped pipeline with the sync-free window clock, every candidate
+lands as a schema-v5 ``sweep`` event in <out>/events.jsonl, and the
+winner can be committed straight into TUNING_TABLE.json (--write-table
+via SWEEP_TABLE). The old hand-built jitted pipeline + ad-hoc
+time.perf_counter loop lives on only in git history.
 
-Usage: [PROF_SIDE=100] python scripts/sweep_engine.py
+Usage: [PROF_SIDE=100] [SWEEP_BUDGET=18] [SWEEP_TABLE=TUNING_TABLE.json]
+       python scripts/sweep_engine.py [sweep-out-dir]
 """
 
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import jax
-import jax.numpy as jnp
-
-from sphexa_tpu.init import init_sedov
-from sphexa_tpu.simulation import Simulation, make_propagator_config
-from sphexa_tpu.sfc.box import make_global_box
-from sphexa_tpu.propagator import _sort_by_keys
-from sphexa_tpu.sph import hydro_std
-from sphexa_tpu.sph import pallas_pairs as pp
-
-SIDE = int(os.environ.get("PROF_SIDE", "100"))
-ITERS = 5
-
-
-def time_config(state, box, const, cell_target, run_cap, gap, group):
-    cfg = make_propagator_config(
-        state, box, const, block=8192, backend="pallas",
-        cell_target=cell_target, run_cap=run_cap, gap=gap,
-    )
-    nbr = cfg.nbr
-    if group != nbr.group:
-        import dataclasses
-        nbr = dataclasses.replace(nbr, group=group)
-
-    x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
-
-    @jax.jit
-    def pipeline(x, y, z, h, m, temp, vx, vy, vz, keys):
-        ranges = pp.group_cell_ranges(x, y, z, h, keys, box, nbr)
-        rho, nc, occ = pp.pallas_density(
-            x, y, z, h, m, keys, box, const, nbr, ranges=ranges)
-        p, c = hydro_std.compute_eos_std(temp, rho, const)
-        cs, _ = pp.pallas_iad(
-            x, y, z, h, m / rho, keys, box, const, nbr, ranges=ranges)
-        out = pp.pallas_momentum_energy_std(
-            x, y, z, vx, vy, vz, h, m, rho, p, c, *cs,
-            keys, box, const, nbr, ranges=ranges)
-        return rho, nc, occ, out[0], ranges.ncells
-
-    from sphexa_tpu.sfc.keys import compute_sfc_keys
-    keys = compute_sfc_keys(x, y, z, box)
-    skeys = jnp.sort(keys)
-    args = (x, y, z, h, m, state.temp, state.vx, state.vy, state.vz, skeys)
-    out = pipeline(*args)
-    jax.block_until_ready(out)
-    occ = int(out[2])
-    if occ > nbr.cap:
-        print(f"  ct={cell_target:4d} rc={run_cap:4d} gap={gap:3d} g={group:3d}"
-              f"  OVERFLOW occ={occ} cap={nbr.cap}")
-        return
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = pipeline(*args)
-    jax.block_until_ready(out)
-    _ = float(jnp.sum(out[3]))  # device_get: force real completion (axon)
-    dt = (time.perf_counter() - t0) / ITERS
-    nrun = float(jnp.mean(out[4].astype(jnp.float32)))
-    print(f"  ct={cell_target:4d} rc={run_cap:4d} gap={gap:3d} g={group:3d}"
-          f"  lvl={nbr.level} cap={nbr.cap} win={nbr.window}"
-          f"  runs~{nrun:5.1f}  {dt*1e3:8.2f} ms")
-
-
-def main():
-    state, box, const = init_sedov(SIDE)
-    sim = Simulation(state, box, const, prop="std", block=8192)
-    for _ in range(2):
-        sim.step()
-    state, box = sim.state, sim.box
-    box = make_global_box(state.x, state.y, state.z, box)
-    state, _, _ = _sort_by_keys(state, box, "hilbert")
-
-    for group in (64, 128, 256):
-        for cell_target in (128, 256):
-            for run_cap, gap in ((1536, 384), (2048, 512), (1024, 256)):
-                try:
-                    time_config(state, box, const, cell_target, run_cap, gap, group)
-                except Exception as e:  # noqa
-                    print(f"  ct={cell_target} rc={run_cap} gap={gap} g={group} FAILED: {type(e).__name__}: {e}"[:160])
-
+from sphexa_tpu.tuning.cli import main
 
 if __name__ == "__main__":
-    main()
+    argv = [
+        "--case", "sedov",
+        "--side", os.environ.get("PROF_SIDE", "100"),
+        "--backend", "pallas",
+        "--knobs", "cell_target,run_cap,gap,group",
+        "--budget", os.environ.get("SWEEP_BUDGET", "18"),
+        "--steps", "3", "--warmup", "1",
+        "--out", sys.argv[1] if len(sys.argv) > 1 else "sweep-engine-out",
+        "--format", "json",
+    ]
+    table = os.environ.get("SWEEP_TABLE")
+    if table:
+        argv += ["--write-table", table]
+    sys.exit(main(argv))

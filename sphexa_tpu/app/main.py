@@ -38,11 +38,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gravity MAC accuracy parameter [0.5]")
     p.add_argument("--G", type=float, default=None, dest="grav_constant",
                    help="gravitational constant override (enables gravity)")
-    p.add_argument("--m2p-cap-margin", type=float, default=1.3,
+    p.add_argument("--m2p-cap-margin", type=float, default=None,
                    dest="m2p_cap_margin",
                    help="gravity M2P interaction-list cap margin [1.3]; "
                         "the M2P eval cost is linear in the cap, overflow "
-                        "is diagnostic-guarded and auto-regrown")
+                        "is diagnostic-guarded and auto-regrown; unset, "
+                        "--tuned may resolve it from the tuning table")
     p.add_argument("--sym-pairs", default=None, choices=("on", "off"),
                    dest="sym_pairs",
                    help="momentum/energy pair-cutoff convention: on = min-h "
@@ -93,12 +94,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "xla elsewhere); pallas off-TPU runs the Mosaic "
                         "kernels in interpret mode — the CPU-mesh "
                         "rehearsal path the multi-chip dry run uses")
-    p.add_argument("--check-every", type=int, default=1,
+    p.add_argument("--check-every", type=int, default=None,
                    dest="check_every",
                    help="deferred cap-checking window: launch N steps "
                         "with no device sync, fetch/verify diagnostics "
                         "in one batch at the window end (default 1 = "
-                        "synchronous)")
+                        "synchronous; unset, --tuned may resolve it "
+                        "from the tuning table)")
+    p.add_argument("--tuned", default=None,
+                   help="resolve engine knobs through a committed tuning "
+                        "table (docs/TUNING.md): 'auto' = the repo's "
+                        "TUNING_TABLE.json, or a table path; explicit "
+                        "flags always win over table entries")
     p.add_argument("--imbalance-ratio", type=float, default=1.5,
                    dest="imbalance_ratio",
                    help="imbalance-watchdog threshold on max/mean of the "
@@ -331,7 +338,9 @@ def main(argv=None) -> int:
                          imbalance_ratio=args.imbalance_ratio,
                          obs_spec=obs_spec, science_rows=True,
                          drift_budget=args.drift_budget,
-                         debug_checks=args.debug_checks, telemetry=telemetry)
+                         debug_checks=args.debug_checks, telemetry=telemetry,
+                         tuned=args.tuned,
+                         workload=case_name or args.init)
     except (NotImplementedError, ValueError) as e:
         print(str(e), file=sys.stderr)
         if recorder is not None:
@@ -351,7 +360,12 @@ def main(argv=None) -> int:
             particles=state.n,
             mesh_shape=tuple(mesh.devices.shape) if mesh is not None
             else None,
-            extra={"case": case_name or args.init, "prop": args.prop},
+            extra={"case": case_name or args.init, "prop": args.prop,
+                   # which knobs the run is actually using and why —
+                   # the manifest-side half of the `tuning` event, so
+                   # history/diff can attribute a perf change to a knob
+                   # change (docs/TUNING.md)
+                   "tuning": sim.tuning_provenance},
         )
         # manifest-point HBM snapshot: pre-compile residency (the state
         # arrays + constants), the baseline the post-compile and flush

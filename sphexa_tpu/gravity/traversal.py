@@ -102,7 +102,7 @@ class GravityConfig:
     m2p_cap_margin: float = 1.3
 
 
-def gravity_tuning(n: int, use_pallas: bool) -> dict:
+def gravity_tuning(n: int, use_pallas: bool, telemetry=None) -> dict:
     """Scale-dependent gravity-solver shape, shared by
     Simulation._configure_gravity and bench.py so the benchmarked config
     IS the production config.
@@ -118,6 +118,16 @@ def gravity_tuning(n: int, use_pallas: bool) -> dict:
     of the block-stage slots at sf=8).
     """
     big = n >= 500_000
+    if telemetry is not None and 450_000 <= n <= 550_000:
+        # the silent cliff: these knobs are a step function of N, and a
+        # run sitting within 10% of the threshold can flip the whole
+        # solver shape (and recompile) on a small particle-count change.
+        # Near the edge, say so — a first-class ``tuning`` event (and a
+        # ConsoleSink-notable line) instead of a mysterious retrace
+        telemetry.event(
+            "tuning", source="heuristic", note="near-threshold",
+            n=int(n), threshold=500_000, big=bool(big),
+        )
     return {
         "target_block": 256 if big else 64,
         "blocks_per_chunk": 8 if big else 32,

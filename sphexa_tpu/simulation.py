@@ -65,23 +65,25 @@ def make_propagator_config(
     box: Box,
     const: SimConstants,
     ngmax: Optional[int] = None,
-    block: int = 2048,
+    block: Optional[int] = None,
     curve: str = "hilbert",
     min_cap: int = 0,
     av_clean: bool = False,
     keep_accels: bool = False,
     keep_fields: bool = False,
     backend: str = "auto",
-    cell_target: int = 128,
-    run_cap: int = 1536,
-    gap: int = 384,
-    group: int = 64,
+    cell_target: Optional[int] = None,
+    run_cap: Optional[int] = None,
+    gap: Optional[int] = None,
+    group: Optional[int] = None,
     device_sizing: bool = False,
     use_lists: bool = False,
-    list_skin_rel: float = 0.2,
+    list_skin_rel: Optional[float] = None,
     list_slot_margin: float = 1.3,
     sizing_cache=None,
     obs_spec=None,
+    tuned: object = None,
+    workload: Optional[str] = None,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -108,6 +110,31 @@ def make_propagator_config(
     if backend == "auto":
         # fused pallas kernels on TPU, portable gather path elsewhere
         backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    # tuned knob resolution (docs/TUNING.md): the engine knobs default to
+    # None so an explicit kwarg stays detectable; precedence is explicit
+    # kwarg > table entry (``tuned=``) > the measured defaults below.
+    # Table lookups here are single-device (P=1) — Simulation resolves
+    # with the real mesh size and passes the winners explicitly.
+    _defaults = {"block": 2048, "cell_target": 128, "run_cap": 1536,
+                 "gap": 384, "group": 64, "list_skin_rel": 0.2}
+    _explicit = {
+        k: v for k, v in (("block", block), ("cell_target", cell_target),
+                          ("run_cap", run_cap), ("gap", gap),
+                          ("group", group),
+                          ("list_skin_rel", list_skin_rel))
+        if v is not None
+    }
+    _tuned = {}
+    if tuned is not None:
+        from sphexa_tpu.tuning.table import resolve_knobs
+
+        _tuned, _ = resolve_knobs(tuned, workload=workload, n=state.n,
+                                  p=1, backend=backend,
+                                  explicit=_explicit)
+    block, cell_target, run_cap, gap, group, list_skin_rel = (
+        _explicit.get(k, _tuned.get(k, _defaults[k]))
+        for k in ("block", "cell_target", "run_cap", "gap", "group",
+                  "list_skin_rel"))
     from sphexa_tpu.neighbors.cell_list import pad_cap, window_cells
 
     if device_sizing:
@@ -240,7 +267,7 @@ class Simulation:
         const: SimConstants,
         prop: str = "std",
         ngmax: Optional[int] = None,
-        block: int = 2048,
+        block: Optional[int] = None,
         curve: str = "hilbert",
         av_clean: bool = False,
         theta: float = 0.5,
@@ -253,12 +280,12 @@ class Simulation:
         turb_settings: Optional[Dict] = None,
         cooling_cfg=None,
         chem=None,
-        check_every: int = 1,
+        check_every: Optional[int] = None,
         num_devices: Optional[int] = None,
         use_lists: bool = True,
-        list_skin_rel: float = 0.2,
+        list_skin_rel: Optional[float] = None,
         halo_mode: str = "sparse",
-        m2p_cap_margin: float = 1.3,
+        m2p_cap_margin: Optional[float] = None,
         donate: object = "auto",
         debug_checks: bool = False,
         telemetry: Optional[Telemetry] = None,
@@ -266,6 +293,8 @@ class Simulation:
         obs_spec=None,
         drift_budget: Optional[float] = None,
         science_rows: bool = False,
+        tuned: object = None,
+        workload: Optional[str] = None,
     ):
         # telemetry registry: every driver-visible control-flow event
         # (reconfigure/rollback/replay/retrace) and step timing reports
@@ -277,6 +306,49 @@ class Simulation:
         # path (pinned by tests/test_telemetry.py's no-sync guard).
         self.telemetry = telemetry if telemetry is not None else Telemetry()
         self._window_t0 = None  # host stamp of the open window's 1st launch
+        # tuned knob resolution (sphexa_tpu/tuning): precedence is
+        # explicit kwarg > table entry > gravity_tuning/default heuristic,
+        # resolved ONCE here and applied through the normal configure
+        # paths below. The tuning-covered constructor params default to
+        # None so explicitness is detectable; ``tuned`` is None / "auto" /
+        # a table path / a knob dict (the sweep's candidate path) and
+        # ``workload`` keys the table lookup (the init case name).
+        explicit_knobs = {
+            k: v for k, v in (("block", block),
+                              ("list_skin_rel", list_skin_rel),
+                              ("m2p_cap_margin", m2p_cap_margin),
+                              ("check_every", check_every))
+            if v is not None
+        }
+        from sphexa_tpu.tuning.table import resolve_knobs
+
+        tuned_knobs, self.tuning_provenance = resolve_knobs(
+            tuned, workload=workload, n=state.n, p=num_devices or 1,
+            backend=backend if backend != "auto" else
+            ("pallas" if jax.default_backend() == "tpu" else "xla"),
+            explicit=explicit_knobs,
+        )
+
+        def _knob(name, default):
+            return explicit_knobs.get(name, tuned_knobs.get(name, default))
+
+        block = _knob("block", 2048)
+        list_skin_rel = _knob("list_skin_rel", 0.2)
+        m2p_cap_margin = _knob("m2p_cap_margin", 1.3)
+        check_every = _knob("check_every", 1)
+        # reconfigure-cost knobs the configure paths consume each time
+        self._nbr_knobs = {k: tuned_knobs[k]
+                           for k in ("cell_target", "run_cap", "gap",
+                                     "group") if k in tuned_knobs}
+        self._grav_knobs = {k: tuned_knobs[k]
+                            for k in ("target_block", "blocks_per_chunk",
+                                      "super_factor") if k in tuned_knobs}
+        if tuned is not None:
+            # the decision is itself telemetry: which knobs are active
+            # and WHY (table entry key + its provenance, or the
+            # heuristic fallthrough on a coverage miss)
+            self.telemetry.event("tuning", workload=workload,
+                                 **self.tuning_provenance)
         # distributed observability (schema v2): the imbalance watchdog
         # fires a first-class event when max/mean of a per-shard metric
         # (pair work, halo rows, halo occupancy) crosses this ratio —
@@ -537,6 +609,9 @@ class Simulation:
             list_slot_margin=self._slot_margin,
             sizing_cache=sizing_cache,
             obs_spec=self._obs_spec,
+            # table-resolved neighbor-engine knobs (cell_target/run_cap/
+            # gap/group); absent keys fall to the factory defaults
+            **self._nbr_knobs,
         )
         if self.gravity_on:
             self._configure_gravity(grav_margin, keys_cache=sizing_cache)
@@ -658,14 +733,24 @@ class Simulation:
         # the same helper so the benchmarked config IS this one
         from sphexa_tpu.gravity.traversal import gravity_tuning
 
+        shape = gravity_tuning(self.state.n,
+                               self._cfg.backend == "pallas",
+                               telemetry=self.telemetry)
+        if self._grav_knobs:
+            shape.update(self._grav_knobs)
+            if "super_factor" in self._grav_knobs:
+                # keep the heuristic's invariant under overrides: the
+                # two-level classification exists only as the pallas
+                # bitmask compaction; sf=0 means the flat sort path
+                shape["compaction"] = (
+                    "bitmask" if shape["super_factor"] > 0
+                    and shape["use_pallas"] else "sort")
         gcfg = estimate_gravity_caps(
             xs, ys, zs, ms, skeys, self.box, gtree, meta,
             GravityConfig(theta=self.theta, bucket_size=self.grav_bucket,
                           G=self.const.g,
                           m2p_cap_margin=self.m2p_cap_margin,
-                          **gravity_tuning(
-                              self.state.n,
-                              self._cfg.backend == "pallas")),
+                          **shape),
             margin=margin,
             # sharded solves classify against the per-shard essential
             # node set (LET analog) instead of the full replicated tree

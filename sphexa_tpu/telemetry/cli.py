@@ -7,6 +7,7 @@
     sphexa-telemetry trace <trace-dir> [--min-coverage F] [--top N]
     sphexa-telemetry history [inputs...] [--root DIR]
     sphexa-telemetry regress --lock <lock.json> [candidate] [--write]
+    sphexa-telemetry tuning <run-dir | TUNING_TABLE.json> [--require K]
 
 ``summary`` reads ``<run-dir>/manifest.json`` + ``events.jsonl`` and
 reports p50/p95/mean step time, retrace/rollback/reconfigure counts and
@@ -56,12 +57,23 @@ gates the committed lock file (``TELEMETRY_LOCK.json``) so a chip-less
 PR cannot regress a locked, chip-measured number (telemetry/history.py;
 exit 0 hold / 1 regressed-or-missing / 2 unreadable).
 
+``tuning`` is the autotuning view (schema v5): on a run dir it renders
+the active knob set and its provenance (the manifest's ``tuning``
+stamp + the ``tuning``/``sweep`` events), exit 1 when the run carries
+no tuning telemetry; on a table file it schema- and registry-validates
+the committed ``TUNING_TABLE.json`` (a stale knob name = exit 1) and
+renders its coverage, with ``--require workload,n,p,backend`` exiting 1
+on a coverage gap.
+
 Crash-truncated runs are EXPLAINED, not merely tolerated: when the
 flight recorder (telemetry/flightrec.py) left a ``blackbox.json``,
 ``summary``/``science`` surface its reason, watchdog state and
 traceback tail next to the partial aggregation.
 
-Deliberately jax-free: summarizing a run must not drag in a backend.
+Deliberately jax-free, with ONE documented exception: summarizing a run
+must not drag in a backend, but ``tuning``'s table validation imports
+``sphexa_tpu.tuning`` (whose import-time registry check needs the live
+config dataclasses, and with them jax) lazily, inside that branch only.
 """
 
 import argparse
@@ -760,6 +772,131 @@ def render_diff(d: Dict) -> str:
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# tuning view (schema v5: the autotuning evidence trail)
+# ---------------------------------------------------------------------------
+
+
+def summarize_tuning_run(run_dir: str) -> Dict:
+    """The tuning story of one run dir: the manifest's top-level
+    ``tuning`` stamp (what the Simulation resolved and why — the app
+    passes it via write_manifest's ``extra``, which splats into the
+    manifest root) plus the ``tuning`` decision events and the
+    ``sweep`` candidates, if this dir is a sphexa-tune sweep."""
+    manifest = read_manifest(run_dir)
+    events, problems = load_events(run_dir)
+    decisions = [e for e in events if e.get("kind") == "tuning"]
+    sweeps = [e for e in events if e.get("kind") == "sweep"]
+    stamp = (manifest or {}).get("tuning")
+    by_status = Counter(e.get("status") for e in sweeps)
+    ok = [e for e in sweeps
+          if e.get("status") == "ok"
+          and isinstance(e.get("value"), (int, float))]
+    return {
+        "run_dir": run_dir,
+        "manifest_tuning": stamp,
+        "decisions": decisions,
+        "sweep_candidates": len(sweeps),
+        "sweep_by_status": dict(by_status),
+        "sweep_best": min(ok, key=lambda e: e["value"]) if ok else None,
+        "schema_problems": problems,
+    }
+
+
+def render_tuning_run(s: Dict) -> str:
+    lines = [f"tuning view: {s['run_dir']}"]
+    stamp = s["manifest_tuning"]
+    if stamp:
+        lines.append(f"  active source: {stamp.get('source')}")
+        if stamp.get("key"):
+            k = stamp["key"]
+            lines.append(f"  table entry:   {k.get('workload')} / "
+                         f"{k.get('n_bucket')} / P={k.get('p')} / "
+                         f"{k.get('backend')}")
+        if stamp.get("knobs"):
+            lines.append("  knobs:         " + ", ".join(
+                f"{k}={v}" for k, v in sorted(stamp["knobs"].items())))
+        if stamp.get("explicit"):
+            lines.append("  explicit:      "
+                         + ", ".join(stamp["explicit"]))
+        prov = stamp.get("entry_provenance")
+        if prov:
+            lines.append(f"  provenance:    run={prov.get('source_run')} "
+                         f"created={prov.get('created')} "
+                         f"objective={prov.get('objective')} "
+                         f"win={prov.get('win')}")
+    for d in s["decisions"]:
+        ctx = " ".join(f"{k}={v}" for k, v in d.items()
+                       if k not in ("v", "seq", "t", "kind"))
+        lines.append(f"  decision: {ctx}")
+    if s["sweep_candidates"]:
+        lines.append(f"  sweep: {s['sweep_candidates']} candidates "
+                     + " ".join(f"{k}={v}" for k, v in
+                                sorted(s["sweep_by_status"].items())))
+        best = s["sweep_best"]
+        if best:
+            lines.append(f"  sweep best: {best.get('knobs')} -> "
+                         f"{best.get('value')} ({best.get('objective')})")
+    if not stamp and not s["decisions"] and not s["sweep_candidates"]:
+        lines.append("  no tuning telemetry (run predates --tuned, or "
+                     "heuristics-only)")
+    return "\n".join(lines)
+
+
+def _tuning_table_cmd(path: str, require: Optional[str],
+                      fmt: str) -> int:
+    """Validate + render a committed table file. Imports the tuning
+    package (and with it jax) lazily — the documented exception to this
+    CLI's jax-free rule; the import itself validates the knob registry
+    against the live configs (drift = exit 1, same as a stale knob)."""
+    try:
+        from sphexa_tpu.tuning import coverage, resolve_entry, \
+            validate_table
+        from sphexa_tpu.tuning.table import load_table
+    except RuntimeError as e:
+        print(f"sphexa-telemetry: {e}", file=sys.stderr)
+        return 1
+    try:
+        table = load_table(path)
+    except FileNotFoundError:
+        raise TelemetryError(f"no such table: {path}")
+    except ValueError as e:
+        raise TelemetryError(str(e))
+    problems = validate_table(table)
+    out = {"table": path, "entries": len(table.get("entries", [])),
+           "problems": problems, "coverage": coverage(table)}
+    gap = None
+    if require:
+        parts = require.split(",")
+        if len(parts) != 4:
+            raise TelemetryError(
+                f"--require wants workload,n,p,backend, got {require!r}")
+        w, n, p, b = parts
+        try:
+            # float() first so the natural "1e6" spelling works
+            n_i, p_i = int(float(n)), int(p)
+        except ValueError:
+            raise TelemetryError(
+                f"--require wants numeric n and p, got {require!r}")
+        entry = resolve_entry(table, w, n_i, p_i, b)
+        gap = entry is None
+        out["require"] = {"workload": w, "n": n_i, "p": p_i,
+                          "backend": b, "covered": not gap}
+    if fmt == "json":
+        print(json.dumps(out, indent=2))
+    else:
+        print(f"tuning table: {path} ({out['entries']} entries)")
+        for key, cov in out["coverage"].items():
+            print(f"  {key}: N {','.join(map(str, cov['n_buckets']))} "
+                  f"P {','.join(map(str, cov['p']))}")
+        for prob in problems:
+            print(f"  PROBLEM: {prob}")
+        if require:
+            print(f"  require {require}: "
+                  f"{'covered' if not gap else 'COVERAGE GAP'}")
+    return 1 if (problems or gap) else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="sphexa-telemetry",
@@ -836,6 +973,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="re-read every source and overwrite the locked "
                          "values (the harvest-day locking step)")
     pr.add_argument("--format", choices=("text", "json"), default="text")
+    pn = sub.add_parser(
+        "tuning",
+        help="autotuning view: a run dir's active knobs + provenance, "
+             "or a TUNING_TABLE.json's validity + coverage")
+    pn.add_argument("target", help="run dir or tuning-table JSON file")
+    pn.add_argument("--require", default=None,
+                    help="workload,n,p,backend — exit 1 when the table "
+                         "has no entry covering it (coverage-gap gate)")
+    pn.add_argument("--format", choices=("text", "json"), default="text")
     return p
 
 
@@ -925,6 +1071,18 @@ def main(argv=None) -> int:
             print(json.dumps(res, indent=2) if args.format == "json"
                   else render_regress(res))
             return 1 if res["regressed"] else 0
+        if args.cmd == "tuning":
+            if os.path.isdir(args.target):
+                if args.require:
+                    raise TelemetryError(
+                        "--require applies to a table file, not a run dir")
+                s = summarize_tuning_run(args.target)
+                print(json.dumps(s, indent=2) if args.format == "json"
+                      else render_tuning_run(s))
+                return 0 if (s["manifest_tuning"] or s["decisions"]
+                             or s["sweep_candidates"]) else 1
+            return _tuning_table_cmd(args.target, args.require,
+                                     args.format)
         d = diff_sides(load_side(args.baseline), load_side(args.candidate),
                        args.threshold, drift=args.drift)
         print(json.dumps(d, indent=2) if args.format == "json"

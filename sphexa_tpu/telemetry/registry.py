@@ -23,13 +23,14 @@ import numpy as np
 #: document the migration in docs/OBSERVABILITY.md. v2 added the
 #: distributed kinds (exchange / shard_load / memory / imbalance), v3
 #: the physics-observability kinds (physics / numerics / drift /
-#: field_health), v4 the time-and-history kinds (phase_attr / crash);
-#: none changed the older kinds, so v4 readers accept v1-v3 files.
-SCHEMA_VERSION = 4
+#: field_health), v4 the time-and-history kinds (phase_attr / crash),
+#: v5 the autotuning kinds (sweep / tuning); none changed the older
+#: kinds, so v5 readers accept v1-v4 files.
+SCHEMA_VERSION = 5
 
 #: event schema versions this reader understands (older versions only
 #: ever ADD kinds, so the per-kind field table below covers them all)
-SUPPORTED_VERSIONS = (1, 2, 3, 4)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5)
 
 #: every event kind the schema admits, with its required payload fields
 #: (beyond the envelope ``v``/``seq``/``t``/``kind``). The CLI's --strict
@@ -84,6 +85,16 @@ EVENT_KINDS: Dict[str, tuple] = {
     # abnormal-exit hooks alongside blackbox.json so the event stream
     # itself records WHY it ends mid-run
     "crash": ("reason",),
+    # -- v5: autotuning kinds (sphexa_tpu/tuning/) ------------------------
+    # one sweep candidate measured by the replay harness: the knob dict
+    # tried, its status ("ok" / "overflow" / "failed"), and on success
+    # the objective name + value (per_step_s, or phase:<name> device us)
+    "sweep": ("candidate", "knobs", "status"),
+    # one tuning decision: where the active knobs came from ("table" /
+    # "heuristic" / "explicit"), plus key/knobs/provenance context —
+    # also emitted by gravity_tuning when N sits within 10% of its
+    # step-function threshold (the near-cliff attribution note)
+    "tuning": ("source",),
 }
 
 #: first schema version each kind appeared in (an older-versioned event
@@ -91,9 +102,10 @@ EVENT_KINDS: Dict[str, tuple] = {
 _V2_ONLY = frozenset({"exchange", "shard_load", "memory", "imbalance"})
 _V3_ONLY = frozenset({"physics", "numerics", "drift", "field_health"})
 _V4_ONLY = frozenset({"phase_attr", "crash"})
+_V5_ONLY = frozenset({"sweep", "tuning"})
 KIND_SINCE: Dict[str, int] = {
-    k: 4 if k in _V4_ONLY else 3 if k in _V3_ONLY
-    else 2 if k in _V2_ONLY else 1
+    k: 5 if k in _V5_ONLY else 4 if k in _V4_ONLY
+    else 3 if k in _V3_ONLY else 2 if k in _V2_ONLY else 1
     for k in EVENT_KINDS
 }
 

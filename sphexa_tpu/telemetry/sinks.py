@@ -57,7 +57,7 @@ class JsonlSink:
 #: console reader actually wants to see; per-step launch/phases spam is
 #: left to the JSONL record)
 _NOTABLE = ("reconfigure", "rollback", "replay", "retrace", "trace",
-            "imbalance", "drift", "field_health")
+            "imbalance", "drift", "field_health", "tuning")
 
 
 class ConsoleSink:

@@ -1,0 +1,65 @@
+"""Autotuning: the observe→decide loop over the telemetry stack.
+
+PRs 4-7 built the measurement side (sync-free step timing, per-phase
+device-time attribution, history/regression evidence); this package
+spends it: a typed knob registry (knobs.py), a workload replay harness
+scored by the existing telemetry clocks (replay.py), a budgeted search
+driver (search.py), and a committed per-(workload, N bucket, P,
+backend) tuning table (table.py, TUNING_TABLE.json) that Simulation
+resolves at configure time via ``tuned="auto"``. See docs/TUNING.md.
+
+Importing this package validates the knob registry against the LIVE
+config dataclasses/signatures — a renamed field fails here, loudly, at
+the first ``import sphexa_tpu.tuning``, instead of a committed table
+silently de-tuning every future run. The import therefore drags in the
+config modules (and jax) — the one documented exception to the
+telemetry CLI's jax-free rule (its ``tuning`` subcommand imports this
+package lazily, inside the branch that needs it).
+"""
+
+from sphexa_tpu.tuning.knobs import (
+    COST_RECONFIGURE,
+    COST_STATIC,
+    GRAVITY_KNOBS,
+    KNOBS,
+    NEIGHBOR_KNOBS,
+    SIMULATION_KNOBS,
+    KnobSpec,
+    knob_names,
+    validate_registry,
+)
+
+validate_registry()
+
+from sphexa_tpu.tuning.replay import (  # noqa: E402
+    ReplaySpec,
+    build_case,
+    measure_candidate,
+    spec_from_manifest,
+)
+from sphexa_tpu.tuning.search import domains_for, run_sweep  # noqa: E402
+from sphexa_tpu.tuning.table import (  # noqa: E402
+    TABLE_SCHEMA,
+    coverage,
+    default_table_path,
+    load_table,
+    make_entry,
+    n_bucket,
+    new_table,
+    resolve_entry,
+    resolve_knobs,
+    save_table,
+    upsert_entry,
+    validate_table,
+)
+
+__all__ = [
+    "KnobSpec", "KNOBS", "knob_names", "validate_registry",
+    "COST_STATIC", "COST_RECONFIGURE",
+    "GRAVITY_KNOBS", "NEIGHBOR_KNOBS", "SIMULATION_KNOBS",
+    "ReplaySpec", "spec_from_manifest", "build_case", "measure_candidate",
+    "domains_for", "run_sweep",
+    "TABLE_SCHEMA", "default_table_path", "n_bucket", "new_table",
+    "load_table", "save_table", "validate_table", "resolve_entry",
+    "resolve_knobs", "upsert_entry", "make_entry", "coverage",
+]

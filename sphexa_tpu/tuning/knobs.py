@@ -1,0 +1,160 @@
+"""The knob registry: every tunable the autotuner may touch, typed.
+
+Each ``KnobSpec`` names the knob, the config surface that OWNS it (a
+frozen dataclass field or a constructor/factory keyword), the candidate
+domain the search driver sweeps, and what changing it costs at runtime
+(``static`` = a fresh ``Simulation``; ``reconfigure`` = applied at the
+existing reconfigure granularity — a recompile, not a rebuild). The
+registry is the single vocabulary shared by the sweep driver, the
+committed ``TUNING_TABLE.json`` and the ``Simulation(tuned=...)``
+resolution path: a knob name outside it is a stale table, not a typo
+to guess around (``sphexa-telemetry tuning`` exits 1 on it).
+
+``validate_registry()`` checks every spec against the REAL owning
+dataclass/signature; ``sphexa_tpu.tuning`` (the package ``__init__``)
+calls it at import so a renamed config field fails loudly at the first
+``import sphexa_tpu.tuning`` instead of silently de-tuning a run. This
+module itself stays import-light (no jax, no config modules) so the
+table tooling can read knob NAMES without dragging in a backend — the
+owning modules are imported only inside ``validate_registry()``.
+"""
+
+import dataclasses
+from typing import Dict, Tuple
+
+#: where a knob's new value takes effect
+COST_STATIC = "static"          # construction-time only (new Simulation)
+COST_RECONFIGURE = "reconfigure"  # applied at reconfigure granularity
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobSpec:
+    """One tunable: identity + owning surface + search domain + cost."""
+
+    name: str
+    #: owning config surface, one of the keys of _OWNERS below
+    owner: str
+    #: field/parameter name on the owner (usually == name)
+    field: str
+    #: candidate values the search driver sweeps, in preference order
+    #: (first = the safe/most-common default)
+    domain: Tuple
+    #: COST_STATIC or COST_RECONFIGURE
+    cost: str
+    description: str = ""
+
+
+#: every registered knob, keyed by name. Domains are the measured
+#: candidate sets from the past sweeps (scripts/sweep_engine.py /
+#: profile_grid.py, docs/NEXT.md rounds 4-6) — the staged search seeds
+#: from them, it does not invent values.
+KNOBS: Dict[str, KnobSpec] = {
+    spec.name: spec
+    for spec in (
+        # -- gravity solver shape (GravityConfig) -------------------------
+        KnobSpec("target_block", "GravityConfig", "target_block",
+                 (64, 128, 256), COST_RECONFIGURE,
+                 "bodies per traversal block (MAC shared per block)"),
+        KnobSpec("blocks_per_chunk", "GravityConfig", "blocks_per_chunk",
+                 (32, 16, 8), COST_RECONFIGURE,
+                 "traversal blocks batched per classification chunk"),
+        KnobSpec("super_factor", "GravityConfig", "super_factor",
+                 (0, 4, 8, 16), COST_RECONFIGURE,
+                 "superblock size in blocks for the two-level "
+                 "classification (0 = flat; > 0 implies the bitmask "
+                 "compaction on the pallas backend)"),
+        KnobSpec("m2p_cap_margin", "GravityConfig", "m2p_cap_margin",
+                 (1.3, 1.15, 1.5), COST_RECONFIGURE,
+                 "M2P interaction-list cap margin (eval cost is linear "
+                 "in the cap; overflow is guarded and auto-regrown)"),
+        # -- neighbor engine (NeighborConfig / make_propagator_config) ----
+        KnobSpec("block", "NeighborConfig", "block",
+                 (2048, 4096, 8192), COST_STATIC,
+                 "particles per processing chunk (memory bound)"),
+        KnobSpec("cell_target", "make_propagator_config", "cell_target",
+                 (128, 64, 256), COST_RECONFIGURE,
+                 "mean cell occupancy the grid level targets"),
+        KnobSpec("run_cap", "NeighborConfig", "run_cap",
+                 (1536, 1024, 2048), COST_RECONFIGURE,
+                 "max slots per merged candidate run (pallas engine)"),
+        KnobSpec("gap", "NeighborConfig", "gap",
+                 (384, 128, 256, 512), COST_RECONFIGURE,
+                 "key-space gap bridged when merging candidate cells"),
+        KnobSpec("group", "NeighborConfig", "group",
+                 (64, 32, 128), COST_RECONFIGURE,
+                 "particles per target group (TravConfig targetSize)"),
+        KnobSpec("list_skin_rel", "PropagatorConfig", "list_skin_rel",
+                 (0.2, 0.1, 0.3), COST_RECONFIGURE,
+                 "Verlet skin as a fraction of the 2h_max search radius "
+                 "(persistent-list rebuild cadence)"),
+        # -- Simulation driver --------------------------------------------
+        KnobSpec("check_every", "Simulation", "check_every",
+                 (1, 4, 8), COST_STATIC,
+                 "deferred resort/verify window: steps launched between "
+                 "batched diagnostic fetches (the resort cadence)"),
+    )
+}
+
+#: owner key -> how to resolve the live surface ("dataclass" validates
+#: a field name via dataclasses.fields; "signature" a keyword parameter
+#: via inspect.signature). Import paths are resolved lazily inside
+#: validate_registry() — see the module docstring.
+_OWNERS = {
+    "GravityConfig": ("dataclass", "sphexa_tpu.gravity.traversal",
+                      "GravityConfig"),
+    "NeighborConfig": ("dataclass", "sphexa_tpu.neighbors.cell_list",
+                       "NeighborConfig"),
+    "PropagatorConfig": ("dataclass", "sphexa_tpu.propagator",
+                         "PropagatorConfig"),
+    "make_propagator_config": ("signature", "sphexa_tpu.simulation",
+                               "make_propagator_config"),
+    "Simulation": ("signature", "sphexa_tpu.simulation", "Simulation"),
+}
+
+#: knobs applied to GravityConfig via the gravity_tuning override path
+GRAVITY_KNOBS = ("target_block", "blocks_per_chunk", "super_factor",
+                 "m2p_cap_margin")
+#: knobs forwarded into make_propagator_config by Simulation._configure
+NEIGHBOR_KNOBS = ("block", "cell_target", "run_cap", "gap", "group",
+                  "list_skin_rel")
+#: knobs resolved on the Simulation constructor itself
+SIMULATION_KNOBS = ("check_every",)
+
+
+def knob_names() -> Tuple[str, ...]:
+    return tuple(KNOBS)
+
+
+def validate_registry() -> None:
+    """Check every spec against its live owning surface; raises
+    ``RuntimeError`` naming each drifted knob. Imports the config
+    modules (and with them jax) — call sites that only need NAMES use
+    the module-level ``KNOBS`` and skip this."""
+    import importlib
+    import inspect
+
+    problems = []
+    for spec in KNOBS.values():
+        if spec.owner not in _OWNERS:
+            problems.append(f"{spec.name}: unknown owner {spec.owner!r}")
+            continue
+        kind, module, attr = _OWNERS[spec.owner]
+        obj = getattr(importlib.import_module(module), attr)
+        if kind == "dataclass":
+            fields = {f.name for f in dataclasses.fields(obj)}
+        else:
+            target = obj.__init__ if inspect.isclass(obj) else obj
+            fields = set(inspect.signature(target).parameters)
+        if spec.field not in fields:
+            problems.append(
+                f"{spec.name}: {spec.owner}.{spec.field} no longer "
+                f"exists (renamed/removed field — update the KnobSpec "
+                f"or the tuning table migration)")
+        if spec.cost not in (COST_STATIC, COST_RECONFIGURE):
+            problems.append(f"{spec.name}: bad cost {spec.cost!r}")
+        if not spec.domain:
+            problems.append(f"{spec.name}: empty domain")
+    if problems:
+        raise RuntimeError(
+            "tuning knob registry drifted from the live configs:\n  "
+            + "\n  ".join(problems))
