@@ -3,9 +3,10 @@
 # history/regression lock -> tier-1 tests — what CI (and a pre-push
 # hook) runs.
 #
-#   scripts/check.sh                  # lint + audit + telemetry + history + tuning + fast tier
+#   scripts/check.sh                  # lint + audit + preflight + telemetry + history + tuning + fast tier
 #   scripts/check.sh --lint-only
 #   scripts/check.sh --audit-only
+#   scripts/check.sh --preflight-only
 #   scripts/check.sh --telemetry-only
 #   scripts/check.sh --history-only
 #   scripts/check.sh --tuning-only
@@ -34,6 +35,21 @@ run_audit() {
         echo "jaxaudit failed (rc=$rc); fix the findings or add an inline"
         echo "'# jaxaudit: disable=JXAxxx -- reason' on the entry"
         echo "registration (docs/STATIC_ANALYSIS.md)."
+        exit $rc
+    fi
+}
+
+run_preflight() {
+    echo "== shardcheck preflight (campaign-shaped SPMD audit, mesh 4) =="
+    # the JXA2xx gate at campaign shapes: collective order, donation-aware
+    # peak HBM rescaled to 64M/16 vs the 16 GiB budget, sharding
+    # propagation + exchange volume — all by tracing only, no compile
+    python -m sphexa_tpu.devtools.audit preflight --mesh 4
+    local rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "preflight failed (rc=$rc); a sharded entry has an order race,"
+        echo "busts the per-device HBM budget at campaign N, or ships more"
+        echo "than its exchange budget (docs/STATIC_ANALYSIS.md, JXA2xx)."
         exit $rc
     fi
 }
@@ -284,6 +300,10 @@ case "${1:-}" in
         run_audit
         exit 0
         ;;
+    --preflight-only)
+        run_preflight
+        exit 0
+        ;;
     --telemetry-only)
         run_telemetry
         exit 0
@@ -300,6 +320,7 @@ esac
 
 run_lint
 run_audit
+run_preflight
 run_telemetry
 run_history
 run_tuning

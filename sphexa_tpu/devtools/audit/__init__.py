@@ -13,10 +13,21 @@ on:
 - JXA105  oversized constants baked into the jaxpr
 - JXA106  collectives over axes outside the declared mesh sharding
 
+The JXA2xx *shardcheck* series audits the SPMD program itself (shared
+analysis in ``spmd.py``; surfaced as ``sphexa-audit preflight``):
+
+- JXA201  mutually order-unconstrained collectives (the rendezvous-race
+          class) not pinned by exchange.chain_after
+- JXA202  donation-aware static peak-HBM liveness — traced toy N and
+          the 64M/P=16 campaign rescale — vs the per-device budget
+- JXA203  particle-shaped operands replicated into shard_map / exchange
+          volume beyond the sizing-derived analytic expectation
+
 Usage::
 
     python -m sphexa_tpu.devtools.audit sphexa_tpu
     sphexa-audit sphexa_tpu --format json
+    sphexa-audit preflight --mesh 4
     sphexa-audit --list-rules
 
 Suppress a finding with an inline comment (with a reason) on or directly
@@ -29,12 +40,15 @@ registry entries can never silently shrink coverage.
 """
 
 from sphexa_tpu.devtools.audit.core import (  # noqa: F401
+    AuditContext,
     Auditor,
     EntryCase,
     EntryPoint,
     EntrySkip,
     all_rules,
+    audit_context,
     entries_from_namespace,
     entrypoint,
+    set_audit_context,
 )
 from sphexa_tpu.devtools.common import Baseline, Finding  # noqa: F401

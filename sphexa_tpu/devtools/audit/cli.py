@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import importlib
 import importlib.util
+import os
 import sys
 from pathlib import Path
 from typing import List, Optional
@@ -35,8 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="sphexa-audit",
         description="jaxaudit: trace-level jaxpr/lowering auditor "
-                    "(rules JXA101-JXA106) over the registered hot "
-                    "entry points.",
+                    "(rules JXA101-JXA106 + SPMD shardcheck "
+                    "JXA201-JXA203) over the registered hot entry "
+                    "points. 'sphexa-audit preflight --help' for the "
+                    "campaign preflight mode.",
     )
     ap.add_argument("targets", nargs="*", default=[_DEFAULT_TARGET],
                     help="registry modules: 'sphexa_tpu' (the package "
@@ -61,10 +64,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="print the rule catalog and exit")
     ap.add_argument("--list-entries", action="store_true",
                     help="print the registered entry points and exit")
-    ap.add_argument("--cpu-devices", type=int, default=2, metavar="N",
+    ap.add_argument("--cpu-devices", type=int,
+                    default=int(os.environ.get("SPHEXA_AUDIT_DEVICES", "2")),
+                    metavar="N",
                     help="bootstrap an N-virtual-device CPU backend "
                          "before jax initializes so sharded entries "
-                         "trace (default 2; 0 = use the ambient backend)")
+                         "trace (default $SPHEXA_AUDIT_DEVICES or 2; "
+                         "0 = use the ambient backend)")
     return ap
 
 
@@ -82,13 +88,20 @@ def _load_target(target: str):
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "preflight":
+        from sphexa_tpu.devtools.audit.preflight import main as preflight_main
+
+        return preflight_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     # heavy imports AFTER argparse so --help stays instant
     from sphexa_tpu.devtools.audit.core import (
         Auditor,
         all_rules,
+        audit_context,
         entries_from_namespace,
+        set_audit_context,
     )
 
     if args.list_rules:
@@ -106,6 +119,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             # entries skip themselves if it can't host their mesh
             print(f"sphexa-audit: note: CPU-mesh bootstrap skipped ({e})",
                   file=sys.stderr)
+        if args.cpu_devices > 2:
+            # sharded registry builders size their mesh from the audit
+            # context, so --cpu-devices 8 really traces a P=8 program
+            import dataclasses
+
+            set_audit_context(dataclasses.replace(
+                audit_context(), mesh_size=args.cpu_devices))
 
     entries = []
     for target in args.targets:

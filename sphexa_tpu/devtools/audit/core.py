@@ -53,6 +53,9 @@ from sphexa_tpu.devtools.common import (
 )
 
 __all__ = [
+    "AuditContext",
+    "audit_context",
+    "set_audit_context",
     "EntryCase",
     "EntryPoint",
     "EntryTrace",
@@ -68,6 +71,38 @@ __all__ = [
 ]
 
 _DISABLE_RE = make_disable_re("jaxaudit")
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditContext:
+    """Process-wide knobs the SPMD (JXA2xx) rules and the registry read.
+
+    ``mesh_size`` is the virtual CPU mesh the sharded registry entries
+    trace on (the CLI's --cpu-devices / preflight's --mesh); the
+    campaign fields parameterize JXA202's symbolic rescale (per-device
+    slab = campaign_n / campaign_devices) and the per-device HBM gate.
+    """
+
+    mesh_size: int = 2
+    campaign_n: int = 64_000_000
+    campaign_devices: int = 16
+    hbm_budget_bytes: int = 16 << 30          # v5e: 16 GiB HBM per chip
+    repl_threshold_bytes: int = 1 << 20       # campaign-scale replication gate
+
+
+_CONTEXT = AuditContext()
+
+
+def audit_context() -> AuditContext:
+    return _CONTEXT
+
+
+def set_audit_context(ctx: AuditContext) -> AuditContext:
+    """Install a new context; returns the previous one (for restore)."""
+    global _CONTEXT
+    prev = _CONTEXT
+    _CONTEXT = ctx
+    return prev
 
 
 class EntrySkip(Exception):
@@ -98,6 +133,12 @@ class EntryCase:
     # scalars (Python floats where the public API tolerates either);
     # the traced OUTPUT signature must match the canonical one
     perturb: Optional[Callable[[Tuple[Any, ...]], Tuple[Any, ...]]] = None
+    # JXA203 volume gate: the analytic cross-shard bytes/step this case
+    # is expected to ship (sizing.sparse_need_matrix / shipped_rows
+    # derived); None = no volume check for this entry
+    exchange_budget_bytes: Optional[int] = None
+    # slack factor on the volume gate (negotiation/metrics overhead)
+    exchange_slack: float = 2.0
 
 
 @dataclasses.dataclass
@@ -118,6 +159,9 @@ class EntryPoint:
     # trace under jax.experimental.enable_x64 (fixture use: the f64
     # rule can't fire with x64 off — jax silently demotes)
     x64: bool = False
+    # per-entry override of the JXA202 per-device HBM budget (bytes);
+    # None = the AuditContext default (16 GiB)
+    hbm_budget: Optional[int] = None
     path: str = "?"
     line: int = 0
 
@@ -137,7 +181,8 @@ def _display_path(filename: str) -> str:
 def entrypoint(name: str, *, donate: Tuple[int, ...] = (),
                mesh_axes: Tuple[str, ...] = (),
                const_bytes_limit: int = 1 << 20,
-               x64: bool = False) -> Callable:
+               x64: bool = False,
+               hbm_budget: Optional[int] = None) -> Callable:
     """Decorator: declare a builder function as an audit entry point.
 
     The decorated function runs lazily (per audit run) and returns an
@@ -151,6 +196,7 @@ def entrypoint(name: str, *, donate: Tuple[int, ...] = (),
             name=name, build=build, donate=tuple(donate),
             mesh_axes=tuple(mesh_axes),
             const_bytes_limit=const_bytes_limit, x64=x64,
+            hbm_budget=hbm_budget,
             path=_display_path(code.co_filename) if code else "?",
             line=code.co_firstlineno if code else 0,
         )

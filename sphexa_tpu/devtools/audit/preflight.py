@@ -1,0 +1,206 @@
+"""sphexa-audit preflight: the SPMD campaign gate.
+
+    sphexa-audit preflight [--mesh P] [--n N] [--hbm-budget BYTES]
+
+Bootstraps a P-virtual-device CPU mesh, retraces every registered entry
+on it, runs the three shardcheck rules (JXA201 collective order, JXA202
+peak-HBM liveness vs budget, JXA203 sharding propagation), and prints a
+per-entry table: collectives traced, chain status, estimated peak HBM
+per device at the toy N and rescaled to campaign shapes, replicated
+particle bytes, and measured exchange bytes vs the analytic budget.
+
+Exit codes mirror sphexa-audit: 0 = clean, 1 = findings or entry
+errors, 2 = usage error. Run it before burning chip minutes — every
+failure class it gates (the PR-5 rendezvous race, a per-device OOM at
+64M/P=16, a partitioner-inserted all-gather of particle fields) is
+cheaper to catch here than on the first campaign launch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+from typing import List, Optional
+
+from sphexa_tpu.devtools.common import Finding, finish_cli, render_table
+
+_PREFLIGHT_RULES = ("JXA201", "JXA202", "JXA203")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="sphexa-audit preflight",
+        description="SPMD preflight auditor: collective-order races, "
+                    "donation-aware peak-HBM vs device budget, and "
+                    "sharding-propagation over the registered sharded "
+                    "entries, chip-free on a virtual CPU mesh.",
+    )
+    ap.add_argument("targets", nargs="*", default=["sphexa_tpu"],
+                    help="registry modules (default: the package registry)")
+    ap.add_argument("--mesh", type=int, metavar="P",
+                    default=int(os.environ.get("SPHEXA_AUDIT_DEVICES", "4")),
+                    help="virtual CPU mesh size the sharded entries trace "
+                         "on (default: $SPHEXA_AUDIT_DEVICES or 4)")
+    ap.add_argument("--n", type=int, default=64_000_000, metavar="N",
+                    help="campaign particle count for the JXA202 rescale "
+                         "(default: 64M)")
+    ap.add_argument("--devices", type=int, default=16, metavar="P",
+                    help="campaign device count (default: 16, v5e-16)")
+    ap.add_argument("--hbm-budget", type=int, default=16 << 30,
+                    metavar="BYTES",
+                    help="per-device HBM budget in bytes (default: 16 GiB)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--entries", metavar="NAMES",
+                    help="comma-separated entry names (default: all)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline with the current findings")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list suppressed/baselined findings")
+    return ap
+
+
+def _row(name: str, rep) -> tuple:
+    from sphexa_tpu.devtools.audit.spmd import format_bytes
+
+    chain = ("ok" if not rep.unordered_pairs
+             else f"RACE({len(rep.unordered_pairs)})")
+    repl = sum(r.campaign_bytes for r in rep.replicated)
+    return (
+        name,
+        len(rep.collectives),
+        chain,
+        format_bytes(rep.toy_peak_bytes),
+        format_bytes(rep.campaign_peak_bytes),
+        format_bytes(repl) if rep.replicated else "-",
+        format_bytes(rep.collective_out_bytes) if rep.collectives else "-",
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.mesh < 2:
+        print("sphexa-audit preflight: --mesh must be >= 2", file=sys.stderr)
+        return 2
+    if args.update_baseline and not args.baseline:
+        print("sphexa-audit preflight: --update-baseline requires "
+              "--baseline", file=sys.stderr)
+        return 2
+
+    from sphexa_tpu.util.cpu_mesh import force_cpu_mesh
+
+    try:
+        force_cpu_mesh(args.mesh)
+    except RuntimeError as e:
+        # in-process use with the backend already up: sharded entries
+        # skip themselves if the ambient mesh can't host --mesh devices
+        print(f"sphexa-audit preflight: note: CPU-mesh bootstrap skipped "
+              f"({e})", file=sys.stderr)
+
+    from sphexa_tpu.devtools.audit.cli import _load_target
+    from sphexa_tpu.devtools.audit.core import (
+        AuditContext,
+        Auditor,
+        EntrySkip,
+        EntryTrace,
+        entries_from_namespace,
+        set_audit_context,
+    )
+    from sphexa_tpu.devtools.audit.spmd import spmd_report
+
+    ctx = AuditContext(
+        mesh_size=args.mesh, campaign_n=args.n,
+        campaign_devices=args.devices, hbm_budget_bytes=args.hbm_budget,
+    )
+    prev = set_audit_context(ctx)
+    try:
+        entries = []
+        for target in args.targets:
+            try:
+                mod = _load_target(target)
+            except (ImportError, OSError, SyntaxError) as e:
+                print(f"sphexa-audit preflight: cannot load target "
+                      f"{target!r}: {e}", file=sys.stderr)
+                return 2
+            entries += entries_from_namespace(vars(mod))
+        if args.entries:
+            want = {s.strip() for s in args.entries.split(",") if s.strip()}
+            unknown = want - {e.name for e in entries}
+            if unknown:
+                print(f"sphexa-audit preflight: unknown entry name(s): "
+                      f"{sorted(unknown)}", file=sys.stderr)
+                return 2
+            entries = [e for e in entries if e.name in want]
+
+        auditor = Auditor(select=list(_PREFLIGHT_RULES))
+        active: List[Finding] = []
+        suppressed: List[Finding] = []
+        errors: List[Finding] = []
+        skipped: List[str] = []
+        rows: List[tuple] = []
+        # one loop that keeps the traces, so the table and the three
+        # rules share a single (expensive) retrace per entry
+        for entry in entries:
+            try:
+                case = entry.build()
+            except EntrySkip as e:
+                skipped.append(f"{entry.name}: {e}")
+                continue
+            except Exception as e:  # noqa: BLE001 - reported as JXA000
+                errors.append(Finding(
+                    rule="JXA000", path=entry.path, line=entry.line, col=0,
+                    message=f"[{entry.name}] entry build failed: "
+                            f"{e.__class__.__name__}: {e}",
+                ))
+                continue
+            trace = EntryTrace(entry, case)
+            table = auditor._suppression_table(entry.path)
+            failed = False
+            for rule in auditor.rules.values():
+                try:
+                    found = rule.check(trace)
+                except Exception as e:  # noqa: BLE001 - reported as JXA000
+                    tb = traceback.format_exc(limit=3)
+                    errors.append(Finding(
+                        rule="JXA000", path=entry.path, line=entry.line,
+                        col=0,
+                        message=f"[{entry.name}] {rule.id} crashed: "
+                                f"{e.__class__.__name__}: {e}\n{tb}",
+                    ))
+                    failed = True
+                    continue
+                for f in found:
+                    if table.is_suppressed(f.rule, f.line):
+                        suppressed.append(f)
+                    else:
+                        active.append(f)
+            if not failed:
+                rows.append(_row(entry.name, spmd_report(trace, ctx)))
+
+        key = lambda f: (f.path, f.line, f.rule, f.message)
+        active.sort(key=key)
+        suppressed.sort(key=key)
+        errors.sort(key=key)
+
+        if args.format == "text":
+            print(render_table(rows, headers=(
+                "entry", "coll", "chain", "peak/dev",
+                f"peak/dev@{args.n}/{args.devices}", "replicated",
+                "exchange")))
+            print(f"campaign: N={args.n} P={args.devices} "
+                  f"budget={args.hbm_budget} B/device; traced mesh "
+                  f"P={args.mesh}")
+        for note in skipped:
+            print(f"sphexa-audit preflight: skipped {note}",
+                  file=sys.stderr)
+        return finish_cli("sphexa-audit preflight", "jaxaudit", args,
+                          active, suppressed, errors)
+    finally:
+        set_audit_context(prev)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,10 +15,14 @@ Conventions:
   elided from ``args_info``).
 - ``carry`` maps (step-1 args, step-1 out) -> step-2 args, giving the
   recompile rule real committed avals (weak types visible).
-- sharded entries declare ``mesh_axes`` and build their mesh from the
-  first devices of the process — on hosts with one device they raise
-  ``EntrySkip`` (the tier-1 gate runs under the 8-virtual-device CPU
-  mesh and asserts no skips; the CLI bootstraps a 2-device CPU mesh).
+- sharded entries declare ``mesh_axes`` and size their mesh from
+  ``audit_context().mesh_size`` (CLI default 2; ``preflight --mesh P``
+  and ``--cpu-devices P`` retrace at campaign-shaped P) — when the
+  process has fewer devices they raise ``EntrySkip`` (the tier-1 gate
+  runs under the 8-virtual-device CPU mesh and asserts no skips).
+- entries carrying an ``exchange_budget_bytes`` declare the analytic
+  cross-shard volume (sizing-derived) the JXA203 gate checks the traced
+  collective output bytes against.
 """
 
 from __future__ import annotations
@@ -26,13 +30,39 @@ from __future__ import annotations
 import dataclasses
 import functools
 
-from sphexa_tpu.devtools.audit.core import EntryCase, EntrySkip, entrypoint
+from sphexa_tpu.devtools.audit.core import (
+    EntryCase,
+    EntrySkip,
+    audit_context,
+    entrypoint,
+)
 
 # tiny-but-nondegenerate case sizes: big enough for a real neighbor grid
 # and a multi-level gravity tree, small enough that a full step traces
 # and runs in ~seconds on a CPU host
 _SIDE = 6          # 216 particles (cube cases)
 _SIDE_GRAV = 6     # sphere cuts (evrard) keep ~half of side^3
+
+# headroom added to every analytic exchange budget before the JXA203
+# volume gate: covers the small fixed-size collectives riding the stage
+# (escape sentinels, the all_gathered telemetry scalars, range bounds)
+_EXCHANGE_HEADROOM = 262_144
+
+
+def _mesh_size_and_side():
+    """Mesh size for sharded entries, from the audit context (CLI
+    default 2 keeps tier-1 cheap; ``preflight --mesh P`` retraces the
+    same builders at campaign-shaped P), plus a cube side whose particle
+    count splits evenly across it (216 doesn't divide by 16)."""
+    import jax
+
+    P = audit_context().mesh_size
+    if len(jax.devices()) < P:
+        raise EntrySkip(f"needs >= {P} devices for the 'p' mesh "
+                        "(sphexa-audit bootstraps one; in-process callers "
+                        "use util.cpu_mesh.force_cpu_mesh)")
+    side = _SIDE if (_SIDE ** 3) % P == 0 else 8
+    return P, side
 
 
 @functools.lru_cache(maxsize=None)
@@ -182,12 +212,8 @@ def halo_exchange_sparse():
     from sphexa_tpu.propagator import shard_map
     from sphexa_tpu.simulation import make_propagator_config
 
-    if len(jax.devices()) < 2:
-        raise EntrySkip("needs >= 2 devices for the 'p' mesh "
-                        "(sphexa-audit bootstraps one; in-process callers "
-                        "use util.cpu_mesh.force_cpu_mesh)")
-    P = 2
-    state, box, const = make_initializer("sedov")(_SIDE)
+    P, side = _mesh_size_and_side()
+    state, box, const = make_initializer("sedov")(side)
     cfg = make_propagator_config(state, box, const)
     # globally SFC-sorted arrays, as the sharded step provides them
     keys = native.compute_keys(
@@ -218,10 +244,13 @@ def halo_exchange_sparse():
         )
         halo = serve((x, y, z, m))
         jx, jy, jz, jm = jbuf((x, y, z, m), halo)
+        # chain the tail reductions after the exchange and each other —
+        # escaped/hmetrics are computed PRE-serve, so without the pins
+        # these collectives race the ppermutes (the JXA201 class)
         esc = jax.lax.pmax(
-            jnp.asarray(escaped, jnp.int32), "p"
+            ex.chain_after(jnp.asarray(escaped, jnp.int32), jx), "p"
         )
-        smetrics = _shard_metrics(ranges, escaped, hmetrics, "p")
+        smetrics = _shard_metrics(ranges, escaped, hmetrics, "p", token=esc)
         return jx, jy, jz, jm, esc, smetrics
 
     Pp, Pr = PartitionSpec("p"), PartitionSpec()
@@ -233,7 +262,160 @@ def halo_exchange_sparse():
         out_specs=(Pp, Pp, Pp, Pp, Pr, {k: Pr for k in SHARD_DIAG_KEYS}),
         check_vma=False,
     ))
-    return EntryCase(fn=fn, args=(box, skeys, x, y, z, h, m))
+    return EntryCase(
+        fn=fn, args=(box, skeys, x, y, z, h, m),
+        # analytic serve volume: hmax rows per peer distance x 4 fields
+        exchange_budget_bytes=sum(hmax) * 4 * 4 + _EXCHANGE_HEADROOM,
+    )
+
+
+@entrypoint("halo_exchange_windowed", mesh_axes=("p",))
+def halo_exchange_windowed():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec
+
+    from sphexa_tpu import native
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.parallel import exchange as ex
+    from sphexa_tpu.parallel import make_mesh
+    from sphexa_tpu.propagator import shard_map
+    from sphexa_tpu.simulation import make_propagator_config
+
+    P, side = _mesh_size_and_side()
+    state, box, const = make_initializer("sedov")(side)
+    cfg = make_propagator_config(state, box, const)
+    keys = native.compute_keys(
+        np.asarray(state.x), np.asarray(state.y), np.asarray(state.z),
+        np.asarray(box.lo), np.asarray(box.lengths), cfg.curve,
+    )
+    order = native.argsort_keys(keys)
+    skeys = jnp.asarray(keys[order])
+    x, y, z, h, m = (
+        jnp.asarray(np.asarray(f)[order])
+        for f in (state.x, state.y, state.z, state.h, state.m)
+    )
+    mesh = make_mesh(P)
+    S_shard = state.n // P
+    Wmax = S_shard  # full-slab windows, as the gravity near field uses
+    nbr = cfg.nbr
+    if nbr.run_cap > S_shard:
+        nbr = dataclasses.replace(nbr, run_cap=S_shard)
+
+    def stage(b, keys, x, y, z, h, m):
+        from sphexa_tpu.propagator import _shard_metrics
+
+        ranges, serve, jbuf, escaped, hmetrics = ex.shard_halo_stage(
+            x, y, z, h, keys, b, nbr, P, Wmax, "p"
+        )
+        halo = serve((x, y, z, m))
+        jx, jy, jz, jm = jbuf((x, y, z, m), halo)
+        esc = jax.lax.pmax(
+            ex.chain_after(jnp.asarray(escaped, jnp.int32), jx), "p"
+        )
+        smetrics = _shard_metrics(ranges, escaped, hmetrics, "p", token=esc)
+        return jx, jy, jz, jm, esc, smetrics
+
+    Pp, Pr = PartitionSpec("p"), PartitionSpec()
+    from sphexa_tpu.propagator import SHARD_DIAG_KEYS
+
+    fn = jax.jit(shard_map(
+        stage, mesh=mesh,
+        in_specs=(Pr, Pp, Pp, Pp, Pp, Pp, Pp),
+        out_specs=(Pp, Pp, Pp, Pp, Pr, {k: Pr for k in SHARD_DIAG_KEYS}),
+        check_vma=False,
+    ))
+    return EntryCase(
+        fn=fn, args=(box, skeys, x, y, z, h, m),
+        # analytic serve volume: one all_to_all of P windows x 4 fields
+        exchange_budget_bytes=P * Wmax * 4 * 4 + _EXCHANGE_HEADROOM,
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharded gravity: psum multipole upsweep + LET traversal + windowed
+# near-field exchange (propagator._gravity_sharded_stage) — the campaign
+# gravity program, traced whole so the JXA2xx rules see the full
+# collective schedule
+# ---------------------------------------------------------------------------
+
+
+@entrypoint("gravity_sharded", mesh_axes=("p",))
+def gravity_sharded():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sphexa_tpu import native
+    from sphexa_tpu import propagator as prop
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.parallel import make_mesh
+    from sphexa_tpu.simulation import Simulation
+
+    P, _ = _mesh_size_and_side()
+    state, box, const = make_initializer("evrard")(_SIDE_GRAV)
+    # evrard's sphere cut leaves an arbitrary n; trim to a multiple of
+    # 16 so one state shards on any audited mesh size
+    n16 = (state.n // 16) * 16
+    state = jax.tree.map(
+        lambda a: a[:n16] if getattr(a, "ndim", 0) == 1 else a, state)
+    sim = Simulation(state, box, const, prop="nbody")
+    s = sim.state
+    keys = native.compute_keys(
+        np.asarray(s.x), np.asarray(s.y), np.asarray(s.z),
+        np.asarray(sim.box.lo), np.asarray(sim.box.lengths), sim.curve,
+    )
+    order = native.argsort_keys(keys)
+    skeys = jnp.asarray(keys[order])
+    xs, ys, zs, ms, hs = (
+        jnp.asarray(np.asarray(f)[order])
+        for f in (s.x, s.y, s.z, s.m, s.h)
+    )
+    sstate = dataclasses.replace(s, x=xs, y=ys, z=zs, m=ms, h=hs)
+    cfg_sh = dataclasses.replace(sim._cfg, mesh=make_mesh(P),
+                                 shard_axis="p")
+    # gtree rides as a TRACED arg (O(tree) replicated coarse structure,
+    # too big for a baked-in jaxpr constant)
+    return EntryCase(
+        fn=lambda st, bb, k, gt: prop._gravity_sharded_stage(
+            st, bb, cfg_sh, gt, k),
+        args=(sstate, sim.box, skeys, sim._gtree),
+    )
+
+
+# ---------------------------------------------------------------------------
+# sharded hydro step: the exact campaign entry — make_sharded_step's
+# propagator config (windowed/sparse halo sizing included) traced over
+# the audit mesh, with the analytic _halo_info exchange budget as the
+# JXA203 volume gate
+# ---------------------------------------------------------------------------
+
+
+@entrypoint("step_std_sharded", mesh_axes=("p",))
+def step_std_sharded():
+    from sphexa_tpu import propagator as prop
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.simulation import Simulation
+
+    P, side = _mesh_size_and_side()
+    state, box, const = make_initializer("sedov")(side)
+    sim = Simulation(state, box, const, prop="std", backend="pallas",
+                     num_devices=P)
+    hi = sim._halo_info
+    # mirror make_sharded_step's config replace so the audited trace IS
+    # the stepper's program (tracing the stepper itself would audit its
+    # device_put re-sharding prologue, a false JXA104 host boundary)
+    cfg_sh = dataclasses.replace(
+        sim._cfg, mesh=sim._mesh, shard_axis="p",
+        halo_window=(hi["wmax"] if hi["mode"] == "windowed" else 0),
+        halo_cells=tuple(hi.get("caps", ())),
+    )
+    return EntryCase(
+        fn=lambda s, b: prop.step_hydro_std(s, b, cfg_sh, None),
+        args=(sim.state, sim.box),
+        exchange_budget_bytes=hi["bytes_per_step"] + _EXCHANGE_HEADROOM,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -279,13 +461,10 @@ def observable_ledger_sharded():
     from sphexa_tpu.parallel import make_mesh, shard_state
     from sphexa_tpu.simulation import make_propagator_config
 
-    if len(jax.devices()) < 2:
-        raise EntrySkip("needs >= 2 devices for the 'p' mesh "
-                        "(sphexa-audit bootstraps one; in-process callers "
-                        "use util.cpu_mesh.force_cpu_mesh)")
-    state, box, const = make_initializer("sedov")(_SIDE)
+    P, side = _mesh_size_and_side()
+    state, box, const = make_initializer("sedov")(side)
     cfg = make_propagator_config(state, box, const)
-    mesh = make_mesh(2)
+    mesh = make_mesh(P)
     sstate = shard_state(state, mesh)
     pspec = NamedSharding(mesh, PartitionSpec("p"))
     rho = jax.device_put(jnp.ones((state.n,)), pspec)

@@ -7,4 +7,7 @@ from sphexa_tpu.devtools.audit.rules import (  # noqa: F401
     jxa104_host_boundary,
     jxa105_const_bloat,
     jxa106_collective_axes,
+    jxa201_collective_order,
+    jxa202_peak_hbm,
+    jxa203_sharding_propagation,
 )

@@ -1,0 +1,49 @@
+"""JXA201: collective-order audit (the PR-5 rendezvous-race class).
+
+XLA guarantees no program order between collectives that are not
+connected through dataflow. On the XLA:CPU rendezvous they can then
+complete in different interleavings on different devices (cross-wired
+payloads or a deadlock — exactly the sparse-exchange race PR 5 fixed by
+hand); on real chips an unpinned order costs ICI stalls and makes
+step-time nondeterministic. The repo's contract is a TOTAL order pinned
+by ``exchange.chain_after`` (an ``optimization_barrier`` data edge), so
+the dependency walk in ``spmd.py`` sees a chained collective as the
+ancestor of its successor. Any pair of named-axis collectives with no
+ancestor relation in either direction is a finding.
+
+Entries with fewer than two collectives are trivially ordered.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import EntryTrace, audit_context, register
+from sphexa_tpu.devtools.audit.spmd import spmd_report
+from sphexa_tpu.devtools.common import Finding
+
+
+@register(
+    "JXA201", "collective-order",
+    "mutually order-unconstrained collectives (XLA rendezvous-race "
+    "class) — pin a total order with exchange.chain_after",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    rep = spmd_report(trace, audit_context())
+    if len(rep.collectives) < 2 or not rep.unordered_pairs:
+        return []
+    examples = []
+    for i, j in rep.unordered_pairs[:4]:
+        a, b = rep.collectives[i], rep.collectives[j]
+        examples.append(f"{a.prim}#{i}[{a.where}] <-> {b.prim}#{j}[{b.where}]")
+    more = len(rep.unordered_pairs) - len(examples)
+    return [trace.finding(
+        "JXA201",
+        f"{len(rep.unordered_pairs)} mutually order-unconstrained "
+        f"collective pair(s) among {len(rep.collectives)} collectives — "
+        f"XLA may rendezvous them in different interleavings per device "
+        f"(deadlock/cross-wired payloads on CPU meshes, ICI stalls on "
+        f"chips). Pin a total order with exchange.chain_after. "
+        f"Unordered: {'; '.join(examples)}"
+        + (f"; +{more} more" if more > 0 else ""),
+    )]

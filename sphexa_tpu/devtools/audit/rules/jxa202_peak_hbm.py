@@ -1,0 +1,58 @@
+"""JXA202: donation-aware static peak-HBM liveness vs the device budget.
+
+A live-interval sweep over the entry's jaxpr (``spmd._peak_liveness``)
+bounds the per-device residency XLA needs: entry args + consts live the
+whole program, intermediates from definition to last use, nested jaxprs
+contribute their internal excess, and a donated arg's matched result is
+credited zero (input-output aliasing — the property JXA103 verifies
+actually lowers). Two numbers come out of one sweep:
+
+- the **toy peak** at the traced N (gated for every entry), and
+- for sharded entries, the **campaign peak**: every buffer at least one
+  per-device slab large is rescaled by
+  ``(campaign_n / campaign_devices) / toy_slab_rows`` — a deliberate
+  upper bound (toy halos cover the whole slab, so they rescale as full
+  campaign slabs).
+
+Either exceeding the per-device budget (entry ``hbm_budget`` override,
+else the AuditContext default / ``--hbm-budget``) is a finding: the 64M
+campaign config would OOM at launch, caught chip-free.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import EntryTrace, audit_context, register
+from sphexa_tpu.devtools.audit.spmd import format_bytes, spmd_report
+from sphexa_tpu.devtools.common import Finding
+
+
+@register(
+    "JXA202", "peak-hbm-liveness",
+    "donation-aware static peak-HBM estimate (toy N and campaign "
+    "rescale) exceeds the per-device budget",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    ctx = audit_context()
+    rep = spmd_report(trace, ctx)
+    budget = trace.entry.hbm_budget or ctx.hbm_budget_bytes
+    over = []
+    if rep.toy_peak_bytes > budget:
+        over.append(f"traced toy N: {format_bytes(rep.toy_peak_bytes)}")
+    if (rep.campaign_peak_bytes is not None
+            and rep.campaign_peak_bytes > budget):
+        slab = ctx.campaign_n // max(ctx.campaign_devices, 1)
+        over.append(
+            f"campaign N={ctx.campaign_n} / P={ctx.campaign_devices} "
+            f"({slab} rows/device): "
+            f"{format_bytes(rep.campaign_peak_bytes)}")
+    if not over:
+        return []
+    return [trace.finding(
+        "JXA202",
+        f"static peak-HBM liveness exceeds the per-device budget "
+        f"{format_bytes(budget)}: {'; '.join(over)} — shrink live "
+        f"buffers (donation, narrower halos, staged gravity arrays) or "
+        f"raise the budget if the device really has the headroom.",
+    )]

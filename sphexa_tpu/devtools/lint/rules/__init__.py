@@ -6,4 +6,5 @@ from sphexa_tpu.devtools.lint.rules import (  # noqa: F401
     jxl003_dtype_policy,
     jxl004_pallas_tiles,
     jxl005_static_args,
+    jxl006_collectives,
 )

@@ -409,6 +409,9 @@ def compute_multipoles_sharded(
     S = x.shape[0]
     k = jax.lax.axis_index(axis)
     pos_local = jnp.searchsorted(local_keys, lk, side="left").astype(jnp.int32)
+    # jaxlint: disable=JXL006 -- data-chained upsweep: every later psum
+    # consumes the previous psum's result (edges -> leaf_w -> leaf_q/c),
+    # so program order is already total (JXA201 proves it on the jaxpr)
     edges = jax.lax.psum(pos_local, axis)  # global leaf boundary rows
     e_clip = jnp.clip(edges - k * S, 0, S)
     # local-row particle->leaf map: leaves starting before the slab clip
@@ -417,6 +420,7 @@ def compute_multipoles_sharded(
     pleaf = _pleaf_from_edges(e_clip, S)
 
     w = jnp.stack([m, m * x, m * y, m * z], axis=1)
+    # jaxlint: disable=JXL006 -- data-chained on edges (via e_clip)
     leaf_w = jax.lax.psum(mp.edge_segment_sum(w, e_clip), axis)  # (L, 4)
     node_mass, node_com = _upsweep_mass_com(leaf_w, tree, meta)
 
@@ -424,12 +428,14 @@ def compute_multipoles_sharded(
     if order > 0:
         from sphexa_tpu.gravity import spherical as sp
 
+        # jaxlint: disable=JXL006 -- data-chained on leaf_w (via leaf_com)
         leaf_c = jax.lax.psum(
             sp.p2m(x, y, z, m, leaf_com, e_clip, order, pleaf=pleaf), axis
         )
         node_q = sp.upsweep(leaf_c, node_com, tree, meta,
                             tree.node_of_leaf, order)
         return node_mass, node_com, node_q, edges
+    # jaxlint: disable=JXL006 -- data-chained on leaf_w (via leaf_com)
     leaf_q = jax.lax.psum(
         mp.p2m_leaf(x, y, z, m, pleaf, leaf_com, num_l, edges=e_clip), axis
     )
@@ -1118,8 +1124,14 @@ def compute_gravity(
         # an escaped near-field run means truncated candidates: the
         # SHARED overflow contract encodes it as a p2p overflow (and
         # pmaxes) so the driver re-sizes the halo window
-        from sphexa_tpu.parallel.exchange import fold_escape_sentinel
+        from sphexa_tpu.parallel.exchange import chain_after, fold_escape_sentinel
 
+        if cfg.use_pallas and jd is not None:
+            # p2p_n comes from the PRE-exchange traversal sweep, so the
+            # overflow pmax has no data order against serve_windows'
+            # all_to_all without this pin (the rendezvous-race class
+            # JXA201 gates)
+            p2p_hw = chain_after(p2p_hw, jd[0])
         p2p_hw = fold_escape_sentinel(p2p_hw, escaped, cfg.p2p_cap, shard[0])
     diagnostics = {
         "m2p_max": jnp.max(m2p_n),

@@ -228,6 +228,30 @@ def rebuild_pair_lists(state: ParticleState, box: Box,
     return state, box, lists, aux
 
 
+def _chain_stage_reductions(egrav, diag, axis):
+    """Pin the gravity stage-tail reductions into one total order.
+
+    egrav/diag arrive per-shard; the psum + diagnostic pmaxes that
+    normalize them are otherwise mutually order-free (and unordered
+    against the traversal's exchange collectives for pure-constant
+    diagnostics like compact_width) — the XLA:CPU rendezvous-race class
+    JXA201 gates. diag["p2p_max"] carries the traversal + exchange
+    ancestry, so seeding the chain there orders the whole tail after
+    the halo all_to_all as well.
+    """
+    from sphexa_tpu.parallel.exchange import chain_after
+
+    tok = diag.get("p2p_max", egrav)
+    egrav = jax.lax.psum(chain_after(egrav, tok), axis)
+    tok = egrav
+    out = {}
+    for k in sorted(diag):
+        v = jax.lax.pmax(chain_after(diag[k], tok), axis)
+        out[k] = v
+        tok = v
+    return egrav, out
+
+
 def _gravity_sharded_stage(state, box, cfg, gtree, keys):
     """Distributed gravity under shard_map: psum multipole upsweep (the
     global_multipole.hpp allreduce analog — O(tree) comm, no particle
@@ -257,8 +281,7 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
                 x, y, z, m, h, keys, box, gtree, cfg.grav_meta, gcfg,
                 cfg.ewald, shard=(axis, P, Wmax),
             )
-            egrav = jax.lax.psum(egrav, axis)
-            diag = {k: jax.lax.pmax(v, axis) for k, v in diag.items()}
+            egrav, diag = _chain_stage_reductions(egrav, diag, axis)
             return gx, gy, gz, egrav, diag
 
         dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
@@ -276,8 +299,7 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
                 x, y, z, m, h, keys, box, gtree, cfg.grav_meta, gcfg,
                 mp_cache=mpc, shard=(axis, P, Wmax),
             )
-            egrav = jax.lax.psum(egrav, axis)
-            diag = {k: jax.lax.pmax(v, axis) for k, v in diag.items()}
+            egrav, diag = _chain_stage_reductions(egrav, diag, axis)
             return gx, gy, gz, egrav, diag
 
         dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),

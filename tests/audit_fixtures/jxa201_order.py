@@ -1,0 +1,46 @@
+"""JXA201 fixtures: two collectives with no data-dependency order (the
+PR-5 XLA:CPU rendezvous-race shape — a ppermute and a pmax that XLA may
+interleave differently per device) vs the same pair pinned into a total
+order with exchange.chain_after."""
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, EntrySkip, entrypoint
+
+
+def _stage_fn(chained: bool):
+    from jax.sharding import PartitionSpec as P
+
+    from sphexa_tpu.parallel import make_mesh
+    from sphexa_tpu.propagator import shard_map
+
+    if len(jax.devices()) < 2:
+        raise EntrySkip("needs >= 2 devices for the fixture mesh")
+    mesh = make_mesh(2)
+
+    def stage(x, y):
+        from sphexa_tpu.parallel.exchange import chain_after
+
+        r = jax.lax.ppermute(x, "p", [(0, 1), (1, 0)])
+        if chained:
+            y = chain_after(y, r)
+        s = jax.lax.pmax(y, "p")
+        return r, s
+
+    return jax.jit(shard_map(
+        stage, mesh=mesh, in_specs=(P("p"), P("p")),
+        out_specs=(P("p"), P()), check_vma=False,
+    ))
+
+
+@entrypoint("unchained_collectives", mesh_axes=("p",))  # expect: JXA201
+def unchained_collectives():
+    return EntryCase(fn=_stage_fn(False),
+                     args=(jnp.zeros(8), jnp.zeros(8)))
+
+
+@entrypoint("chained_collectives", mesh_axes=("p",))
+def chained_collectives():
+    return EntryCase(fn=_stage_fn(True),
+                     args=(jnp.zeros(8), jnp.zeros(8)))

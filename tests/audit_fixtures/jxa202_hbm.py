@@ -1,0 +1,29 @@
+"""JXA202 fixtures: the same elementwise program against the same
+per-entry HBM budget — without donation the input and output buffers
+coexist and bust it; with donation (the aliasing JXA103 verifies) the
+output is credited onto the input buffer and the entry fits."""
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+_N = 1 << 16                      # 256 KiB of f32
+_BYTES = _N * 4
+_BUDGET = _BYTES + _BYTES // 2    # fits one buffer + slack, not two
+
+
+def _shift(x):
+    return x + 1.0
+
+
+@entrypoint("undonated_over_budget", hbm_budget=_BUDGET)  # expect: JXA202
+def undonated_over_budget():
+    return EntryCase(fn=_shift, args=(jnp.zeros(_N),))
+
+
+@entrypoint("donated_within_budget", donate=(0,), hbm_budget=_BUDGET)
+def donated_within_budget():
+    jitted = jax.jit(_shift, donate_argnums=0)
+    x = jnp.zeros(_N)
+    return EntryCase(fn=_shift, args=(x,), lower=lambda: jitted.lower(x))

@@ -1,0 +1,77 @@
+"""JXA203 fixtures: (a) a particle-shaped operand entering a shard_map
+fully replicated (the implicit all-gather the LET program exists to
+avoid) vs the same operand sharded; (b) a stage whose collective output
+volume busts its declared analytic exchange budget vs one with the
+honest budget."""
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, EntrySkip, entrypoint
+
+_N = 4096
+
+
+def _mesh_or_skip():
+    from sphexa_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 2:
+        raise EntrySkip("needs >= 2 devices for the fixture mesh")
+    return make_mesh(2)
+
+
+def _gather_fn(replicate: bool):
+    from jax.sharding import PartitionSpec as P
+
+    from sphexa_tpu.propagator import shard_map
+
+    mesh = _mesh_or_skip()
+
+    def stage(xs, tbl):
+        return xs + jnp.sum(tbl)
+
+    return jax.jit(shard_map(
+        stage, mesh=mesh,
+        in_specs=(P("p"), P() if replicate else P("p")),
+        out_specs=P("p"), check_vma=False,
+    ))
+
+
+@entrypoint("replicated_particle_operand", mesh_axes=("p",))  # expect: JXA203
+def replicated_particle_operand():
+    return EntryCase(fn=_gather_fn(True),
+                     args=(jnp.zeros(_N), jnp.zeros(_N)))
+
+
+@entrypoint("sharded_particle_operand", mesh_axes=("p",))
+def sharded_particle_operand():
+    return EntryCase(fn=_gather_fn(False),
+                     args=(jnp.zeros(_N), jnp.zeros(_N)))
+
+
+def _permute_fn():
+    from jax.sharding import PartitionSpec as P
+
+    from sphexa_tpu.propagator import shard_map
+
+    mesh = _mesh_or_skip()
+    return jax.jit(shard_map(
+        lambda x: jax.lax.ppermute(x, "p", [(0, 1), (1, 0)]),
+        mesh=mesh, in_specs=P("p"), out_specs=P("p"), check_vma=False,
+    ))
+
+
+@entrypoint("volume_over_budget", mesh_axes=("p",))  # expect: JXA203
+def volume_over_budget():
+    # the ppermute ships a full per-shard slab; the declared analytic
+    # budget covers an eighth of it, slack included
+    return EntryCase(fn=_permute_fn(), args=(jnp.zeros(_N),),
+                     exchange_budget_bytes=(_N // 2) * 4 // 8,
+                     exchange_slack=2.0)
+
+
+@entrypoint("volume_within_budget", mesh_axes=("p",))
+def volume_within_budget():
+    return EntryCase(fn=_permute_fn(), args=(jnp.zeros(_N),),
+                     exchange_budget_bytes=(_N // 2) * 4,
+                     exchange_slack=2.0)

@@ -1,0 +1,41 @@
+"""JXL006 fixture: direct lax collectives vs chain_after-routed ones."""
+
+import jax
+from jax import lax
+
+from sphexa_tpu.parallel.exchange import chain_after
+
+
+def unchained_pair(x, y):
+    r = jax.lax.ppermute(x, "p", [(0, 1), (1, 0)])   # expect: JXL006
+    s = jax.lax.pmax(y, "p")                          # expect: JXL006
+    return r, s
+
+
+def aliased_import_collective(x):
+    return lax.psum(x, "p")                           # expect: JXL006
+
+
+def chained_pair(x, y):
+    r = jax.lax.ppermute(x, "p", [(0, 1), (1, 0)])   # ok: chain token below
+    s = jax.lax.pmax(chain_after(y, r), "p")         # ok: order pinned
+    return r, s
+
+
+def outer_chains(x, y):
+    r = jax.lax.ppermute(x, "p", [(0, 1), (1, 0)])   # ok: enclosing chains
+
+    def tail(v):
+        return jax.lax.psum(v, "p")                  # ok: enclosing chains
+
+    return tail(chain_after(y, r))
+
+
+def suppressed_upsweep(w):
+    # data-chained pyramid: each psum feeds the next, order is total
+    a = jax.lax.psum(w, "p")      # jaxlint: disable=JXL006 -- data-chained
+    return jax.lax.psum(a, "p")   # jaxlint: disable=JXL006 -- data-chained
+
+
+def coordinate_read(x):
+    return x + jax.lax.axis_index("p")               # ok: no comm, not flagged

@@ -34,7 +34,8 @@ _EXPECT_RE = re.compile(
     r"#\s*expect:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
 )
 
-ALL_RULE_IDS = ["JXA101", "JXA102", "JXA103", "JXA104", "JXA105", "JXA106"]
+ALL_RULE_IDS = ["JXA101", "JXA102", "JXA103", "JXA104", "JXA105", "JXA106",
+                "JXA201", "JXA202", "JXA203"]
 
 
 def expected_findings(path: Path):
@@ -173,6 +174,60 @@ def test_cli_usage_errors(tmp_path):
     assert audit_main([str(FIXTURES / "jxa105_const.py"),
                        "--cpu-devices", "0",
                        "--entries", "nope"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# preflight (the JXA2xx campaign gate: sphexa-audit preflight)
+# ---------------------------------------------------------------------------
+
+
+def test_preflight_package_clean_at_p4(capsys):
+    """The campaign acceptance gate: the package registry preflights
+    clean on a P=4 CPU mesh — all three shardcheck rules active, zero
+    findings, zero suppressions — and the table renders the campaign
+    peak-HBM column for the sharded step."""
+    from sphexa_tpu.devtools.audit.preflight import main as preflight_main
+
+    assert preflight_main(["--mesh", "4"]) == 0
+    out = capsys.readouterr().out
+    for col in ("entry", "coll", "chain", "peak/dev", "replicated",
+                "exchange"):
+        assert col in out
+    assert "step_std_sharded" in out and "gravity_sharded" in out
+    assert "RACE" not in out
+    assert "suppressed" not in out
+
+
+def test_preflight_flags_unchained_collectives(capsys):
+    """The PR-5 rendezvous-race shape must fail preflight (exit 1) and
+    show up as RACE in the chain column."""
+    from sphexa_tpu.devtools.audit.preflight import main as preflight_main
+
+    assert preflight_main([str(FIXTURES / "jxa201_order.py"),
+                           "--mesh", "2"]) == 1
+    out = capsys.readouterr().out
+    assert "RACE" in out
+    assert "JXA201" in out
+
+
+def test_preflight_usage_errors():
+    from sphexa_tpu.devtools.audit.preflight import main as preflight_main
+
+    assert preflight_main(["--mesh", "1"]) == 2
+    assert preflight_main(["no_such_module_xyz", "--mesh", "2"]) == 2
+    assert preflight_main(["--update-baseline", "--mesh", "2"]) == 2
+
+
+def test_preflight_campaign_budget_flags_propagate(capsys):
+    """--hbm-budget reaches the JXA202 gate: an absurdly low budget
+    must fail the sharded step's campaign estimate."""
+    from sphexa_tpu.devtools.audit.preflight import main as preflight_main
+
+    rc = preflight_main(["--mesh", "2", "--entries", "step_std_sharded",
+                         "--hbm-budget", str(1 << 20)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "JXA202" in out
 
 
 # ---------------------------------------------------------------------------
