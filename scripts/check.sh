@@ -3,10 +3,11 @@
 # history/regression lock -> tier-1 tests — what CI (and a pre-push
 # hook) runs.
 #
-#   scripts/check.sh                  # lint + audit + preflight + telemetry + history + tuning + fast tier
+#   scripts/check.sh                  # lint + audit + preflight + cost + telemetry + history + tuning + fast tier
 #   scripts/check.sh --lint-only
 #   scripts/check.sh --audit-only
 #   scripts/check.sh --preflight-only
+#   scripts/check.sh --cost-only
 #   scripts/check.sh --telemetry-only
 #   scripts/check.sh --history-only
 #   scripts/check.sh --tuning-only
@@ -50,6 +51,46 @@ run_preflight() {
         echo "preflight failed (rc=$rc); a sharded entry has an order race,"
         echo "busts the per-device HBM budget at campaign N, or ships more"
         echo "than its exchange budget (docs/STATIC_ANALYSIS.md, JXA2xx)."
+        exit $rc
+    fi
+}
+
+run_cost() {
+    echo "== jaxcost (static roofline audit, budget gate, calibration band) =="
+    local rc
+    # the JXA3xx gate: every registry entry's static per-phase cost vs
+    # the committed COST_BUDGET.json, phase coverage, bound declarations
+    python -m sphexa_tpu.devtools.audit cost
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "sphexa-audit cost failed (rc=$rc): an entry busted its"
+        echo "COST_BUDGET.json phase ceiling, lost phase coverage, or a"
+        echo "declared-compute-bound phase went memory-bound"
+        echo "(docs/STATIC_ANALYSIS.md, JXA3xx)."
+        exit $rc
+    fi
+    # the committed budget file itself must stay schema-valid
+    python - <<'EOF'
+from sphexa_tpu.devtools.audit.costmodel import load_budget
+load_budget("COST_BUDGET.json")
+EOF
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "COST_BUDGET.json failed schema validation (rc=$rc)"
+        exit $rc
+    fi
+    # calibration band: the static prediction of the committed fixture
+    # target must sit inside the band calibration.json declares against
+    # the committed capture — a drifted per-primitive cost rule fails
+    # HERE before it silently re-ranks any static-cost sweep
+    env JAX_PLATFORMS=cpu python -m sphexa_tpu.telemetry trace \
+        tests/trace_fixture --predict
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "trace --predict calibration failed (rc=$rc): the cost"
+        echo "model drifted from the committed capture; fix the rules or"
+        echo "regenerate with scripts/make_trace_fixture.py"
+        echo "(docs/STATIC_ANALYSIS.md)."
         exit $rc
     fi
 }
@@ -304,6 +345,10 @@ case "${1:-}" in
         run_preflight
         exit 0
         ;;
+    --cost-only)
+        run_cost
+        exit 0
+        ;;
     --telemetry-only)
         run_telemetry
         exit 0
@@ -321,6 +366,7 @@ esac
 run_lint
 run_audit
 run_preflight
+run_cost
 run_telemetry
 run_history
 run_tuning

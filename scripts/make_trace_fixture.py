@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Regenerate tests/trace_fixture — the committed miniature capture the
+traceview and calibration tests pin against.
+
+The fixture is one jit program with three ``sphexa/<phase>`` scopes
+(density: a dot + tanh; neighbors: a cumsum, whose CPU lowering
+exercises the metadata-less computation-inheritance path; momentum-
+energy: elementwise) plus a deliberately UNSCOPED tail dot, so the
+capture's coverage sits strictly between the 0.5 and 0.999 gates the
+fixture tests pin.
+
+The same program is exported as ``@entrypoint("trace_fixture")`` so it
+is also the CALIBRATION TARGET: ``calibration.json`` records, per
+phase, the measured-us / statically-predicted-us ratio of this exact
+capture at the cpu-smoke device model. ``sphexa-telemetry trace
+tests/trace_fixture --predict`` re-predicts (pure arithmetic — fully
+deterministic) and fails when a fresh ratio leaves the recorded band:
+a per-primitive cost rule drifting silently is exactly what it catches.
+
+Usage (from the repo root; writes tests/trace_fixture/*):
+
+    JAX_PLATFORMS=cpu python scripts/make_trace_fixture.py
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable as `python scripts/...` from anywhere
+    sys.path.insert(0, _REPO)
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint  # noqa: E402
+_DEST = os.path.join(_REPO, "tests", "trace_fixture")
+#: repo-relative target recorded in calibration.json (resolved by
+#: sphexa-audit's _load_target, so --predict must run from the root)
+_TARGET = "scripts/make_trace_fixture.py::trace_fixture"
+_DEVICE = "cpu-smoke"
+_TOLERANCE = 2.0
+_PHASES = ("density", "momentum-energy", "neighbors")
+
+_SIDE = 384          # density dot M=N=K
+_ROWS, _COLS = 4096, 256
+
+
+def _arrays():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    a = jnp.asarray(rng.standard_normal((_SIDE, _SIDE)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((_SIDE, _SIDE)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((_ROWS, _COLS)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((_COLS, 64)), jnp.float32)
+    return a, b, v, w
+
+
+def _step(a, b, v, w):
+    import jax.numpy as jnp
+
+    from sphexa_tpu.util.phases import phase_scope
+
+    with phase_scope("density"):
+        d = jnp.tanh(a @ b) + a
+    with phase_scope("neighbors"):
+        nb = jnp.cumsum(v, axis=0)
+    with phase_scope("momentum-energy"):
+        m = nb * 0.5 + jnp.sin(nb)
+    # deliberately UNSCOPED tail: keeps the capture's coverage below
+    # the 0.999 gate the fixture tests pin (and above 0.5)
+    return d.sum() + (m @ w).sum()
+
+
+@entrypoint("trace_fixture")
+def trace_fixture():
+    return EntryCase(fn=_step, args=_arrays())
+
+
+def _flatten_capture(tmp: str) -> None:
+    """Copy the newest xplane + perfetto dump flat into tests/
+    trace_fixture under the committed names."""
+    xp = sorted(glob.glob(os.path.join(tmp, "**", "*.xplane.pb"),
+                          recursive=True), key=os.path.getmtime)
+    tj = sorted(glob.glob(os.path.join(tmp, "**", "*.trace.json.gz"),
+                          recursive=True), key=os.path.getmtime)
+    if not xp or not tj:
+        raise SystemExit(f"profiler produced no capture under {tmp} "
+                         f"(xplanes={xp}, traces={tj})")
+    os.makedirs(_DEST, exist_ok=True)
+    shutil.copy(xp[-1], os.path.join(_DEST, "vm.xplane.pb"))
+    shutil.copy(tj[-1], os.path.join(_DEST, "vm.trace.json.gz"))
+
+
+def main() -> int:
+    import jax
+
+    from sphexa_tpu.devtools.audit.costmodel import (
+        CALIBRATION_FILE,
+        predict_for_target,
+    )
+    from sphexa_tpu.telemetry.traceview import summarize_trace
+
+    case = trace_fixture.build()
+    step = jax.jit(case.fn)
+    step(*case.args).block_until_ready()  # compile OUTSIDE the capture
+
+    with tempfile.TemporaryDirectory() as tmp:
+        with jax.profiler.trace(tmp):
+            for _ in range(3):
+                step(*case.args).block_until_ready()
+        _flatten_capture(tmp)
+
+    s = summarize_trace(_DEST)
+    phases = {p["phase"]: p["us"] for p in s["phases"]}
+    print(f"capture: {s['device_op_events']} device ops, "
+          f"{s['total_device_us']:.1f}us, coverage {s['coverage']:.4f}")
+    for ph, us in sorted(phases.items(), key=lambda kv: -kv[1]):
+        print(f"  {ph:18s} {us:10.1f}us")
+    missing = [p for p in _PHASES if phases.get(p, 0) <= 0]
+    if missing:
+        raise SystemExit(f"fixture lost phases {missing} — the capture "
+                         f"does not satisfy the test pins; not writing "
+                         f"calibration")
+    if not 0.5 < s["coverage"] < 0.999:
+        raise SystemExit(f"coverage {s['coverage']:.4f} outside the "
+                         f"(0.5, 0.999) band the fixture tests pin")
+
+    pred = predict_for_target(_TARGET, _DEVICE)
+    doc = {
+        "schema": 1,
+        "target": _TARGET,
+        "device": _DEVICE,
+        "tolerance": _TOLERANCE,
+        "phases": {},
+    }
+    for ph in _PHASES:
+        row = pred.row(ph)
+        if row is None or row.ms <= 0:
+            raise SystemExit(f"no static prediction for phase {ph!r}")
+        doc["phases"][ph] = {
+            "ratio": phases[ph] / (row.ms * 1e3),
+            "measured_us": phases[ph],
+            "predicted_us": row.ms * 1e3,
+        }
+    path = os.path.join(_DEST, CALIBRATION_FILE)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {path}")
+    for ph, spec in sorted(doc["phases"].items()):
+        print(f"  {ph:18s} ratio {spec['ratio']:10.3f}  "
+              f"(measured {spec['measured_us']:.1f}us / predicted "
+              f"{spec['predicted_us']:.3f}us)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

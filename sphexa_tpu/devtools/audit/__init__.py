@@ -22,12 +22,27 @@ analysis in ``spmd.py``; surfaced as ``sphexa-audit preflight``):
           the 64M/P=16 campaign rescale — vs the per-device budget
 - JXA203  particle-shaped operands replicated into shard_map / exchange
           volume beyond the sizing-derived analytic expectation
+- JXA204  rescale-exempt (tree/work) buffers growing superlinearly in N
+          across a two-point trace probe
+
+The JXA3xx *jaxcost* series is the static roofline cost model
+(``costmodel.py`` + ``devices.py``; surfaced as ``sphexa-audit cost``):
+per-phase FLOPs/HBM/ICI off the jaxpr via the ``sphexa/<phase>``
+name-stack scopes, classified against a device model:
+
+- JXA301  static FLOPs falling outside the phase taxonomy (coverage
+          floor + off-taxonomy scope names)
+- JXA302  predicted per-phase ms above the committed COST_BUDGET.json
+          ceilings (the static analog of TELEMETRY_LOCK.json)
+- JXA303  a declared-compute-bound phase whose arithmetic intensity
+          sits below the device ridge point
 
 Usage::
 
     python -m sphexa_tpu.devtools.audit sphexa_tpu
     sphexa-audit sphexa_tpu --format json
     sphexa-audit preflight --mesh 4
+    sphexa-audit cost --device v5e
     sphexa-audit --list-rules
 
 Suppress a finding with an inline comment (with a reason) on or directly

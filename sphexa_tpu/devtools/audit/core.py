@@ -88,6 +88,19 @@ class AuditContext:
     campaign_devices: int = 16
     hbm_budget_bytes: int = 16 << 30          # v5e: 16 GiB HBM per chip
     repl_threshold_bytes: int = 1 << 20       # campaign-scale replication gate
+    # --- jaxcost (JXA3xx / JXA204) knobs ---------------------------------
+    # device model the cost rules predict against (devices.py)
+    cost_device: str = "v5e"
+    # JXA301 default: minimum attributed-FLOP share per entry (per-entry
+    # phase_coverage_min overrides; the step builders sit near 1.0)
+    phase_coverage_min: float = 0.7
+    # JXA302 default budget file (repo-root committed); an entry may pin
+    # its own via EntryPoint.cost_budget_file. A missing DEFAULT file
+    # skips the gate (out-of-repo use); a missing DECLARED file fails.
+    cost_budget_path: str = "COST_BUDGET.json"
+    # JXA204: growth-probe slack over linear-in-N for the exempt
+    # (non-slab) buffer class
+    tree_growth_slack: float = 1.25
 
 
 _CONTEXT = AuditContext()
@@ -139,6 +152,9 @@ class EntryCase:
     exchange_budget_bytes: Optional[int] = None
     # slack factor on the volume gate (negotiation/metrics overhead)
     exchange_slack: float = 2.0
+    # JXA204 growth probe: rebuild the SAME entry at a larger toy N
+    # (returns (grown EntryCase, n_ratio)); None = no growth probe
+    grow: Optional[Callable[[], Tuple["EntryCase", float]]] = None
 
 
 @dataclasses.dataclass
@@ -162,6 +178,17 @@ class EntryPoint:
     # per-entry override of the JXA202 per-device HBM budget (bytes);
     # None = the AuditContext default (16 GiB)
     hbm_budget: Optional[int] = None
+    # JXA301 override: minimum attributed-FLOP share (None = the
+    # AuditContext default; 0.0 exempts reconfigure-time programs that
+    # legitimately run outside the step-phase taxonomy)
+    phase_coverage_min: Optional[float] = None
+    # JXA302 override: per-entry budget file instead of the context
+    # default COST_BUDGET.json (fixtures pin doctored budgets this way)
+    cost_budget_file: Optional[str] = None
+    # JXA303: phases this entry DECLARES compute-bound; one of them
+    # sitting below the device ridge point is a finding (an interaction
+    # kernel that degraded into a bandwidth-bound gather loop)
+    expect_compute_bound: Tuple[str, ...] = ()
     path: str = "?"
     line: int = 0
 
@@ -182,7 +209,10 @@ def entrypoint(name: str, *, donate: Tuple[int, ...] = (),
                mesh_axes: Tuple[str, ...] = (),
                const_bytes_limit: int = 1 << 20,
                x64: bool = False,
-               hbm_budget: Optional[int] = None) -> Callable:
+               hbm_budget: Optional[int] = None,
+               phase_coverage_min: Optional[float] = None,
+               cost_budget_file: Optional[str] = None,
+               expect_compute_bound: Tuple[str, ...] = ()) -> Callable:
     """Decorator: declare a builder function as an audit entry point.
 
     The decorated function runs lazily (per audit run) and returns an
@@ -197,6 +227,9 @@ def entrypoint(name: str, *, donate: Tuple[int, ...] = (),
             mesh_axes=tuple(mesh_axes),
             const_bytes_limit=const_bytes_limit, x64=x64,
             hbm_budget=hbm_budget,
+            phase_coverage_min=phase_coverage_min,
+            cost_budget_file=cost_budget_file,
+            expect_compute_bound=tuple(expect_compute_bound),
             path=_display_path(code.co_filename) if code else "?",
             line=code.co_firstlineno if code else 0,
         )

@@ -1,0 +1,294 @@
+"""sphexa-audit cost: the static roofline cost gate.
+
+    sphexa-audit cost [--entries ...] [--device v5e] [--json]
+
+Retraces every registered entry (trace-only — no execution, no chip),
+walks the jaxpr through the per-primitive cost rules, attributes each
+eqn to the step-phase taxonomy via its ``sphexa/<phase>`` name-stack
+scope, and classifies the per-phase FLOP / HBM-byte / ICI-byte totals
+against a device model into a predicted-ms roofline table. On top of
+the table it runs the three cost rules: JXA301 (phase coverage), JXA302
+(predicted ms vs the committed ``COST_BUDGET.json`` ceiling) and JXA303
+(declared-compute-bound phase below the ridge point), plus the JXA303
+REPORT section listing every memory-bound phase — the static ranking of
+ROADMAP item-2's fusion/cadence candidates.
+
+Exit codes mirror sphexa-audit: 0 = clean, 1 = findings or entry
+errors, 2 = usage error. Calibration against a real capture lives in
+``sphexa-telemetry trace <dir> --predict`` (docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import traceback
+from typing import Any, Dict, List, Optional
+
+from sphexa_tpu.devtools.common import (
+    Baseline,
+    Finding,
+    finish_cli,
+    render_table,
+)
+
+_COST_RULES = ("JXA301", "JXA302", "JXA303")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="sphexa-audit cost",
+        description="static per-phase roofline cost model: per-primitive "
+                    "FLOP/HBM/ICI accounting over the registered entries' "
+                    "jaxprs, classified against a device model, gated by "
+                    "rules JXA301-JXA303. Chip-free.",
+    )
+    ap.add_argument("targets", nargs="*", default=["sphexa_tpu"],
+                    help="registry modules (default: the package registry)")
+    ap.add_argument("--device", default="v5e", metavar="NAME",
+                    help="device model to classify against "
+                         "(see devtools/audit/devices.py; default: v5e)")
+    ap.add_argument("--entries", metavar="NAMES",
+                    help="comma-separated entry names (default: all)")
+    ap.add_argument("--budget", metavar="FILE",
+                    help="COST_BUDGET.json path for JXA302 "
+                         "(default: COST_BUDGET.json if present)")
+    ap.add_argument("--coverage-min", type=float, metavar="F",
+                    help="override the JXA301 phase-coverage floor")
+    ap.add_argument("--top", type=int, default=0, metavar="K",
+                    help="print only the K heaviest phases per entry "
+                         "(default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full machine-readable payload (per-entry "
+                         "per-phase rows + findings) instead of the table")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="findings render for the non---json path")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline with the current findings")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also list suppressed/baselined findings")
+    ap.add_argument("--cpu-devices", type=int,
+                    default=int(os.environ.get("SPHEXA_AUDIT_DEVICES", "2")),
+                    metavar="N",
+                    help="bootstrap an N-virtual-device CPU backend so "
+                         "sharded entries trace (default: "
+                         "$SPHEXA_AUDIT_DEVICES or 2; 0 = ambient backend)")
+    return ap
+
+
+def _fmt_flops(f: float) -> str:
+    if f >= 1e9:
+        return f"{f / 1e9:.2f}G"
+    if f >= 1e6:
+        return f"{f / 1e6:.2f}M"
+    if f >= 1e3:
+        return f"{f / 1e3:.1f}K"
+    return f"{f:.0f}"
+
+
+def _entry_payload(name: str, pred) -> Dict[str, Any]:
+    return {
+        "entry": name,
+        "device": pred.device,
+        "coverage": pred.coverage,
+        "total_ms": pred.total_ms,
+        "total_ms_upper": pred.total_ms_upper,
+        "unknown_scopes": list(pred.unknown_scopes),
+        "unattributed": pred.unattributed.as_dict(),
+        "phases": [r.as_dict() for r in pred.rows],
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.update_baseline and not args.baseline:
+        print("sphexa-audit cost: --update-baseline requires --baseline",
+              file=sys.stderr)
+        return 2
+
+    from sphexa_tpu.devtools.audit.devices import device_names, get_device
+
+    try:
+        dev = get_device(args.device)
+    except ValueError:
+        print(f"sphexa-audit cost: unknown device {args.device!r} "
+              f"(known: {', '.join(device_names())})", file=sys.stderr)
+        return 2
+
+    if args.cpu_devices and args.cpu_devices > 0:
+        from sphexa_tpu.util.cpu_mesh import force_cpu_mesh
+
+        try:
+            force_cpu_mesh(args.cpu_devices)
+        except RuntimeError as e:
+            print(f"sphexa-audit cost: note: CPU-mesh bootstrap skipped "
+                  f"({e})", file=sys.stderr)
+
+    from sphexa_tpu.devtools.audit.cli import _load_target
+    from sphexa_tpu.devtools.audit.core import (
+        Auditor,
+        EntrySkip,
+        EntryTrace,
+        audit_context,
+        entries_from_namespace,
+        set_audit_context,
+    )
+    from sphexa_tpu.devtools.audit.costmodel import (
+        cost_report,
+        memory_bound_phases,
+        predict,
+    )
+
+    ctx = dataclasses.replace(
+        audit_context(),
+        cost_device=dev.name,
+        **({"cost_budget_path": args.budget} if args.budget else {}),
+        **({"phase_coverage_min": args.coverage_min}
+           if args.coverage_min is not None else {}),
+        **({"mesh_size": args.cpu_devices} if args.cpu_devices > 2 else {}),
+    )
+    prev = set_audit_context(ctx)
+    try:
+        entries = []
+        for target in args.targets:
+            try:
+                mod = _load_target(target)
+            except (ImportError, OSError, SyntaxError) as e:
+                print(f"sphexa-audit cost: cannot load target {target!r}: "
+                      f"{e}", file=sys.stderr)
+                return 2
+            entries += entries_from_namespace(vars(mod))
+        if args.entries:
+            want = {s.strip() for s in args.entries.split(",") if s.strip()}
+            unknown = want - {e.name for e in entries}
+            if unknown:
+                print(f"sphexa-audit cost: unknown entry name(s): "
+                      f"{sorted(unknown)}", file=sys.stderr)
+                return 2
+            entries = [e for e in entries if e.name in want]
+
+        auditor = Auditor(select=list(_COST_RULES))
+        active: List[Finding] = []
+        suppressed: List[Finding] = []
+        errors: List[Finding] = []
+        skipped: List[str] = []
+        rows: List[tuple] = []
+        payload: List[Dict[str, Any]] = []
+        mem_bound: List[str] = []
+        # one loop that keeps the traces, so the table and the three
+        # rules share a single (expensive) retrace per entry
+        for entry in entries:
+            try:
+                case = entry.build()
+            except EntrySkip as e:
+                skipped.append(f"{entry.name}: {e}")
+                continue
+            except Exception as e:  # noqa: BLE001 - reported as JXA000
+                errors.append(Finding(
+                    rule="JXA000", path=entry.path, line=entry.line, col=0,
+                    message=f"[{entry.name}] entry build failed: "
+                            f"{e.__class__.__name__}: {e}",
+                ))
+                continue
+            trace = EntryTrace(entry, case)
+            table = auditor._suppression_table(entry.path)
+            failed = False
+            for rule in auditor.rules.values():
+                try:
+                    found = rule.check(trace)
+                except Exception as e:  # noqa: BLE001 - reported as JXA000
+                    tb = traceback.format_exc(limit=3)
+                    errors.append(Finding(
+                        rule="JXA000", path=entry.path, line=entry.line,
+                        col=0,
+                        message=f"[{entry.name}] {rule.id} crashed: "
+                                f"{e.__class__.__name__}: {e}\n{tb}",
+                    ))
+                    failed = True
+                    continue
+                for f in found:
+                    if table.is_suppressed(f.rule, f.line):
+                        suppressed.append(f)
+                    else:
+                        active.append(f)
+            if failed:
+                continue
+            try:
+                pred = predict(cost_report(trace, ctx), dev)
+            except Exception as e:  # noqa: BLE001 - reported as JXA000
+                errors.append(Finding(
+                    rule="JXA000", path=entry.path, line=entry.line, col=0,
+                    message=f"[{entry.name}] cost model failed: "
+                            f"{e.__class__.__name__}: {e}",
+                ))
+                continue
+            payload.append(_entry_payload(entry.name, pred))
+            mem_bound += [f"{entry.name}/{r.phase}"
+                          for r in memory_bound_phases(pred, dev)]
+            shown = pred.rows[:args.top] if args.top > 0 else pred.rows
+            for r in shown:
+                rows.append((
+                    entry.name, r.phase, r.dtype, _fmt_flops(r.flops),
+                    f"{r.ai:.2f}", f"{r.ms:.4f}", r.bound,
+                ))
+            rows.append((
+                entry.name, "= total", "-", _fmt_flops(
+                    sum(r.flops for r in pred.rows)
+                    + pred.unattributed.flops),
+                "-", f"{pred.total_ms:.4f}",
+                f"cov={pred.coverage:.3f}",
+            ))
+
+        key = lambda f: (f.path, f.line, f.rule, f.message)
+        active.sort(key=key)
+        suppressed.sort(key=key)
+        errors.sort(key=key)
+
+        for note in skipped:
+            print(f"sphexa-audit cost: skipped {note}", file=sys.stderr)
+
+        if args.json:
+            # machine-readable path: full payload, findings inline
+            try:
+                baseline = Baseline.load(args.baseline) if args.baseline \
+                    else Baseline.empty()
+            except (ValueError, OSError) as e:
+                print(f"sphexa-audit cost: cannot read baseline "
+                      f"{args.baseline}: {e}", file=sys.stderr)
+                return 2
+            new, grandfathered = baseline.filter_new(active)
+            print(json.dumps({
+                "tool": "jaxcost",
+                "device": dev.name,
+                "ridge_f32": dev.ridge("float32"),
+                "entries": payload,
+                "memory_bound": mem_bound,
+                "findings": [f.to_json() for f in new],
+                "grandfathered": [f.to_json() for f in grandfathered],
+                "suppressed": [f.to_json() for f in suppressed],
+                "errors": [f.to_json() for f in errors],
+                "skipped": skipped,
+            }, indent=2, sort_keys=True))
+            return 1 if (new or errors) else 0
+
+        print(render_table(rows, headers=(
+            "entry", "phase", "dtype", "flops", "AI", "ms", "bound")))
+        print(f"device: {dev.name} (ridge {dev.ridge('float32'):.1f} "
+              f"FLOP/B @ float32); predicted ms = "
+              f"max(compute, HBM-lower, ICI)")
+        if mem_bound:
+            print(f"memory-bound phases (AI < ridge): "
+                  f"{', '.join(mem_bound)}")
+        return finish_cli("sphexa-audit cost", "jaxcost", args,
+                          active, suppressed, errors)
+    finally:
+        set_audit_context(prev)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

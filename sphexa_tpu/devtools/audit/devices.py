@@ -1,0 +1,101 @@
+"""Device models for the static roofline cost layer (jaxcost).
+
+A ``DeviceModel`` is the small set of numbers a roofline needs: peak
+FLOP/s by dtype, HBM bandwidth, and ICI bandwidth. ``costmodel.predict``
+divides the per-phase FLOP/byte tallies by these to get a predicted
+per-phase ms table and classifies each phase against the ridge point
+(peak FLOP/s / HBM B/s — the arithmetic intensity above which a kernel
+is compute-bound).
+
+These are MODELS, not measurements. Assumptions, in one place:
+
+- ``v5e``: 197 TFLOP/s bf16 (the public MXU peak), f32 modeled at 1/4
+  of that (MXU f32 passes + the VPU's elementwise rate — SPH phases are
+  VPU-heavy, so this is deliberately conservative), 16 GiB HBM at
+  819 GB/s, and 4x ICI links modeled at 180 GB/s aggregate per chip.
+- ``cpu-smoke``: a deliberately round model of the CI host XLA-CPU
+  backend (a few GFLOP/s, tens of GB/s DRAM). It exists so the
+  calibration fixture (``sphexa-telemetry trace tests/trace_fixture
+  --predict``) has a device to predict against; its absolute numbers
+  only shift every phase's ratio by a COMMON factor, which the
+  committed per-phase calibration band absorbs.
+
+Integer/bool arithmetic is charged at the f32 rate (``default_peak``):
+the audited programs are f32-dominated and the sort/key phases mix int
+ops through the same vector units.
+
+Import-light by design (stdlib only): the costmodel contract mirrors
+``spmd.py`` — importable without jax, CLI-safe for --help paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+__all__ = ["DeviceModel", "DEVICES", "get_device"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceModel:
+    """Roofline parameters for one device class."""
+
+    name: str
+    description: str
+    #: peak FLOP/s keyed by numpy dtype name ("float32", "bfloat16", ...)
+    peak_flops: Dict[str, float]
+    #: FLOP/s charged for dtypes absent from ``peak_flops`` (ints, bools)
+    default_peak: float
+    #: HBM (or DRAM) bandwidth, bytes/s
+    hbm_bytes_per_s: float
+    #: aggregate inter-chip interconnect bandwidth, bytes/s
+    ici_bytes_per_s: float
+
+    def peak_for(self, dtype_name: str) -> float:
+        return self.peak_flops.get(dtype_name, self.default_peak)
+
+    def ridge(self, dtype_name: str = "float32") -> float:
+        """Arithmetic intensity (FLOPs/byte) at the compute/memory-bound
+        boundary for ``dtype_name``."""
+        return self.peak_for(dtype_name) / self.hbm_bytes_per_s
+
+
+DEVICES: Dict[str, DeviceModel] = {
+    "v5e": DeviceModel(
+        name="v5e",
+        description="TPU v5e chip (the ROADMAP campaign target)",
+        peak_flops={
+            "bfloat16": 197e12,
+            "float32": 49.25e12,
+            "float64": 1e12,     # software f64: the JXA101 policy bans it
+        },
+        default_peak=49.25e12,
+        hbm_bytes_per_s=819e9,
+        ici_bytes_per_s=180e9,
+    ),
+    "cpu-smoke": DeviceModel(
+        name="cpu-smoke",
+        description="CI-host XLA-CPU backend (calibration fixture only)",
+        peak_flops={
+            "bfloat16": 4e9,
+            "float32": 8e9,
+            "float64": 4e9,
+        },
+        default_peak=8e9,
+        hbm_bytes_per_s=20e9,
+        ici_bytes_per_s=1e9,
+    ),
+}
+
+
+def device_names() -> Tuple[str, ...]:
+    return tuple(sorted(DEVICES))
+
+
+def get_device(name: str) -> DeviceModel:
+    try:
+        return DEVICES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown device model {name!r} (known: {', '.join(device_names())})"
+        ) from None

@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="BYTES",
                     help="per-device HBM budget in bytes (default: 16 GiB)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full machine-readable payload (per-entry "
+                         "rows + campaign parameters + findings) instead of "
+                         "the table; supersedes --format")
     ap.add_argument("--entries", metavar="NAMES",
                     help="comma-separated entry names (default: all)")
     ap.add_argument("--baseline", metavar="FILE",
@@ -78,6 +82,29 @@ def _row(name: str, rep) -> tuple:
         format_bytes(repl) if rep.replicated else "-",
         format_bytes(rep.collective_out_bytes) if rep.collectives else "-",
     )
+
+
+def _entry_payload(name: str, rep, case) -> dict:
+    """Machine-readable per-entry preflight row (the --json contract:
+    everything the text table shows, in bytes, plus the declared
+    exchange budget the text table folds into JXA203)."""
+    return {
+        "entry": name,
+        "mesh_size": rep.mesh_size,
+        "collectives": len(rep.collectives),
+        "chain": "ok" if not rep.unordered_pairs else "race",
+        "unordered_pairs": len(rep.unordered_pairs),
+        "toy_peak_bytes": rep.toy_peak_bytes,
+        "campaign_peak_bytes": rep.campaign_peak_bytes,
+        "toy_slab_rows": rep.toy_slab_rows,
+        "campaign_ratio": rep.campaign_ratio,
+        "n_global": rep.n_global,
+        "replicated_campaign_bytes":
+            sum(r.campaign_bytes for r in rep.replicated),
+        "exchange_bytes": rep.collective_out_bytes,
+        "exchange_budget_bytes": getattr(case, "exchange_budget_bytes",
+                                         None),
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -141,6 +168,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         errors: List[Finding] = []
         skipped: List[str] = []
         rows: List[tuple] = []
+        payload: List[dict] = []
         # one loop that keeps the traces, so the table and the three
         # rules share a single (expensive) retrace per entry
         for entry in entries:
@@ -178,12 +206,49 @@ def main(argv: Optional[List[str]] = None) -> int:
                     else:
                         active.append(f)
             if not failed:
-                rows.append(_row(entry.name, spmd_report(trace, ctx)))
+                rep = spmd_report(trace, ctx)
+                rows.append(_row(entry.name, rep))
+                payload.append(_entry_payload(entry.name, rep, case))
 
         key = lambda f: (f.path, f.line, f.rule, f.message)
         active.sort(key=key)
         suppressed.sort(key=key)
         errors.sort(key=key)
+
+        for note in skipped:
+            print(f"sphexa-audit preflight: skipped {note}",
+                  file=sys.stderr)
+
+        if args.json:
+            # machine-readable path: per-entry rows, campaign
+            # parameters, and the findings, one document
+            import json
+
+            from sphexa_tpu.devtools.common import Baseline
+
+            try:
+                baseline = Baseline.load(args.baseline) if args.baseline \
+                    else Baseline.empty()
+            except (ValueError, OSError) as e:
+                print(f"sphexa-audit preflight: cannot read baseline "
+                      f"{args.baseline}: {e}", file=sys.stderr)
+                return 2
+            new, grandfathered = baseline.filter_new(active)
+            print(json.dumps({
+                "tool": "jaxaudit-preflight",
+                "campaign": {
+                    "n": args.n, "devices": args.devices,
+                    "hbm_budget_bytes": args.hbm_budget,
+                    "traced_mesh": args.mesh,
+                },
+                "entries": payload,
+                "findings": [f.to_json() for f in new],
+                "grandfathered": [f.to_json() for f in grandfathered],
+                "suppressed": [f.to_json() for f in suppressed],
+                "errors": [f.to_json() for f in errors],
+                "skipped": skipped,
+            }, indent=2, sort_keys=True))
+            return 1 if (new or errors) else 0
 
         if args.format == "text":
             print(render_table(rows, headers=(
@@ -193,9 +258,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"campaign: N={args.n} P={args.devices} "
                   f"budget={args.hbm_budget} B/device; traced mesh "
                   f"P={args.mesh}")
-        for note in skipped:
-            print(f"sphexa-audit preflight: skipped {note}",
-                  file=sys.stderr)
         return finish_cli("sphexa-audit preflight", "jaxaudit", args,
                           active, suppressed, errors)
     finally:

@@ -42,6 +42,9 @@ from sphexa_tpu.devtools.audit.core import (
 # and runs in ~seconds on a CPU host
 _SIDE = 6          # 216 particles (cube cases)
 _SIDE_GRAV = 6     # sphere cuts (evrard) keep ~half of side^3
+# second trace point for the JXA204 tree-growth probe: large enough for
+# a real N jump, small enough that the extra retrace stays cheap
+_SIDE_GROW = 8
 
 # headroom added to every analytic exchange budget before the JXA203
 # volume gate: covers the small fixed-size collectives riding the stage
@@ -83,11 +86,10 @@ def _sim(case: str, side: int, prop: str = "std"):
 # ---------------------------------------------------------------------------
 
 
-@entrypoint("step_std", donate=(0,))
-def step_std():
+def _step_std_case(side: int) -> EntryCase:
     from sphexa_tpu import propagator as prop
 
-    sim = _sim("sedov", _SIDE, prop="std")
+    sim = _sim("sedov", side, prop="std")
     cfg, state, box = sim._cfg, sim.state, sim.box
     return EntryCase(
         fn=lambda s, b: prop.step_hydro_std(s, b, cfg, None),
@@ -96,6 +98,16 @@ def step_std():
                                                         None),
         carry=lambda a, out: (out[0], out[1]),
     )
+
+
+@entrypoint("step_std", donate=(0,))
+def step_std():
+    case = _step_std_case(_SIDE)
+    # JXA204 growth probe: the same step at _SIDE_GROW — cell grids and
+    # scan accumulators must not grow superlinearly in N
+    case.grow = lambda: (_step_std_case(_SIDE_GROW),
+                         _SIDE_GROW ** 3 / _SIDE ** 3)
+    return case
 
 
 @entrypoint("step_ve", donate=(0,))
@@ -165,15 +177,15 @@ def step_std_cooling():
 # ---------------------------------------------------------------------------
 
 
-@entrypoint("gravity_solve")
-def gravity_solve():
+def _gravity_case(side: int):
+    """(EntryCase, n) for the evrard gravity solve at one toy side."""
     import jax.numpy as jnp
     import numpy as np
 
     from sphexa_tpu import native
     from sphexa_tpu.gravity.traversal import compute_gravity
 
-    sim = _sim("evrard", _SIDE_GRAV, prop="nbody")
+    sim = _sim("evrard", side, prop="nbody")
     s, box = sim.state, sim.box
     keys = native.compute_keys(
         np.asarray(s.x), np.asarray(s.y), np.asarray(s.z),
@@ -190,7 +202,21 @@ def gravity_solve():
         fn=lambda x, y, z, m, h, sk, b, gt: compute_gravity(
             x, y, z, m, h, sk, b, gt, meta, gcfg),
         args=(xs, ys, zs, ms, hs, skeys, box, sim._gtree),
-    )
+    ), int(s.n)
+
+
+@entrypoint("gravity_solve")
+def gravity_solve():
+    case, n = _gravity_case(_SIDE_GRAV)
+    # JXA204 growth probe: the round-10 carried caution names exactly
+    # this entry — a superlinear TREE build hiding in the traced-size
+    # exemption. Two-point probe at _SIDE_GROW closes it.
+    def grow():
+        grown, n2 = _gravity_case(_SIDE_GROW)
+        return grown, n2 / n
+
+    case.grow = grow
+    return case
 
 
 # ---------------------------------------------------------------------------
@@ -482,7 +508,9 @@ def observable_ledger_sharded():
 # ---------------------------------------------------------------------------
 
 
-@entrypoint("tree_build_sizing")
+# phase_coverage_min=0: reconfigure-time program — none of its work runs
+# inside a step-phase scope, so JXA301's taxonomy gate does not apply.
+@entrypoint("tree_build_sizing", phase_coverage_min=0.0)
 def tree_build_sizing():
     from sphexa_tpu.init import make_initializer
     from sphexa_tpu.parallel import sizing

@@ -10,4 +10,8 @@ from sphexa_tpu.devtools.audit.rules import (  # noqa: F401
     jxa201_collective_order,
     jxa202_peak_hbm,
     jxa203_sharding_propagation,
+    jxa204_tree_growth,
+    jxa301_phase_coverage,
+    jxa302_cost_budget,
+    jxa303_memory_bound,
 )

@@ -1,0 +1,58 @@
+"""JXA301: static phase-attribution coverage.
+
+The cost model (and the chip-harvest traceview attribution it predicts)
+is only as good as the ``sphexa/<phase>`` named scopes: an eqn outside
+every scope rolls into the unattributed bucket, invisible to both the
+static ranking and the measured per-phase table. Two ways the scopes
+rot land here:
+
+- the entry's **attributed-FLOP share** falls below the threshold
+  (``AuditContext.phase_coverage_min``, or the entry's own
+  ``phase_coverage_min`` — reconfigure-time programs like
+  ``tree_build_sizing`` run outside the step taxonomy and declare 0.0);
+- an eqn lands in a ``sphexa/<x>`` scope with **x outside the
+  util/phases.py taxonomy** — a typo'd or ad-hoc scope name that
+  traceview would silently bucket as a brand-new phase.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import EntryTrace, audit_context, register
+from sphexa_tpu.devtools.audit.costmodel import cost_report
+from sphexa_tpu.devtools.common import Finding
+
+
+@register(
+    "JXA301", "phase-coverage",
+    "attributed-FLOP share below the per-entry threshold, or an eqn "
+    "stamped with a scope outside the util/phases.py taxonomy",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    ctx = audit_context()
+    rep = cost_report(trace, ctx)
+    out: List[Finding] = []
+
+    if rep.unknown_scopes:
+        out.append(trace.finding(
+            "JXA301",
+            f"eqns stamped with scope(s) outside the util/phases.py "
+            f"taxonomy: {', '.join(rep.unknown_scopes)} — traceview would "
+            f"bucket these as brand-new phases; use util.phases.named_phase "
+            f"(or extend PHASES) instead of ad-hoc scope strings.",
+        ))
+
+    floor = trace.entry.phase_coverage_min
+    if floor is None:
+        floor = ctx.phase_coverage_min
+    if rep.total_flops > 0 and rep.coverage < floor:
+        out.append(trace.finding(
+            "JXA301",
+            f"only {rep.coverage:.1%} of static FLOPs attribute to named "
+            f"phases (threshold {floor:.0%}) — "
+            f"{rep.unattributed.flops:.3g} FLOPs run outside every "
+            f"sphexa/<phase> scope and will be invisible in chip captures; "
+            f"wrap the unattributed stages with util.phases.named_phase.",
+        ))
+    return out

@@ -945,6 +945,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "phases (the chip-harvest gate)")
     pt.add_argument("--top", type=int, default=8,
                     help="unattributed ops to list [8]")
+    pt.add_argument("--predict", action="store_true",
+                    help="join the measured per-phase times against the "
+                         "static roofline prediction of the capture's "
+                         "committed calibration.json target; exit 1 when "
+                         "any measured/predicted ratio leaves the "
+                         "recorded band (the jaxcost calibration gate)")
+    pt.add_argument("--device", default=None,
+                    help="with --predict: override the calibration's "
+                         "device model (devtools/audit/devices.py)")
     ph2 = sub.add_parser(
         "history",
         help="cross-run trend over BENCH_r*/MULTICHIP_r* rounds and "
@@ -1019,8 +1028,43 @@ def main(argv=None) -> int:
             )
 
             s = summarize_trace(args.trace_dir, top=args.top)
-            print(json.dumps(s, indent=2) if args.format == "json"
-                  else render_trace(s))
+            joined = None
+            if args.predict:
+                # measured-vs-static calibration: the jaxcost gate
+                from sphexa_tpu.devtools.audit.costmodel import (
+                    calibration_join,
+                    load_calibration,
+                )
+
+                calib = load_calibration(args.trace_dir)
+                if calib is None:
+                    raise TelemetryError(
+                        f"{args.trace_dir}: no calibration.json — "
+                        f"--predict needs the committed calibration "
+                        f"declaration (scripts/make_trace_fixture.py "
+                        f"writes the fixture's)")
+                if args.device:
+                    calib = dict(calib, device=args.device)
+                joined = calibration_join(s, calib)
+            if args.format == "json":
+                out = dict(s, calibration=joined) if joined else s
+                print(json.dumps(out, indent=2))
+            else:
+                print(render_trace(s))
+                if joined:
+                    print(f"calibration: {joined['target']} @ "
+                          f"{joined['device']} (tolerance "
+                          f"{joined['tolerance']:g}x)")
+                    for row in joined["rows"]:
+                        if "ratio" in row:
+                            lo, hi = row["band"]
+                            print(f"  {row['phase']:18s} measured "
+                                  f"{row['measured_us']:10.1f}us  "
+                                  f"predicted {row['predicted_us']:10.3f}us"
+                                  f"  ratio {row['ratio']:8.3f} in "
+                                  f"[{lo:.3f}, {hi:.3f}]  {row['status']}")
+                        else:
+                            print(f"  {row['phase']:18s} {row['status']}")
             if not s["phases"]:
                 return 1  # an unattributed capture must not pass green
             if args.min_coverage is not None \
@@ -1028,6 +1072,11 @@ def main(argv=None) -> int:
                 print(f"sphexa-telemetry: coverage {s['coverage']:.1%} "
                       f"below --min-coverage {args.min_coverage:.1%}",
                       file=sys.stderr)
+                return 1
+            if joined and not joined["ok"]:
+                for v in joined["violations"]:
+                    print(f"sphexa-telemetry: calibration: {v}",
+                          file=sys.stderr)
                 return 1
             return 0
         if args.cmd == "history":

@@ -36,6 +36,7 @@ from sphexa_tpu.tuning.replay import (  # noqa: E402
     build_case,
     measure_candidate,
     spec_from_manifest,
+    static_cost_candidate,
 )
 from sphexa_tpu.tuning.search import domains_for, run_sweep  # noqa: E402
 from sphexa_tpu.tuning.table import (  # noqa: E402
@@ -58,6 +59,7 @@ __all__ = [
     "COST_STATIC", "COST_RECONFIGURE",
     "GRAVITY_KNOBS", "NEIGHBOR_KNOBS", "SIMULATION_KNOBS",
     "ReplaySpec", "spec_from_manifest", "build_case", "measure_candidate",
+    "static_cost_candidate",
     "domains_for", "run_sweep",
     "TABLE_SCHEMA", "default_table_path", "n_bucket", "new_table",
     "load_table", "save_table", "validate_table", "resolve_entry",

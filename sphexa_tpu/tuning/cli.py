@@ -49,8 +49,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup", type=int, default=1,
                    help="unmeasured warmup windows per candidate")
     p.add_argument("--objective", default="per_step_s",
-                   help="per_step_s, or phase:<name> to score one phase "
-                        "of the device-time table (runs under a trace)")
+                   help="per_step_s; phase:<name> to score one phase of "
+                        "the device-time table (runs under a trace); or "
+                        "static-cost:<name> to score the phase's static "
+                        "roofline prediction CHIP-FREE (jaxcost; see "
+                        "docs/STATIC_ANALYSIS.md for the calibration "
+                        "caveat)")
+    p.add_argument("--cost-device", default="v5e", dest="cost_device",
+                   help="device model a static-cost objective predicts "
+                        "against (devtools/audit/devices.py) [v5e]")
     p.add_argument("--out", default="tune-out",
                    help="sweep run dir (events.jsonl / manifest / "
                         "blackbox land here)")
@@ -76,7 +83,8 @@ def main(argv=None) -> int:
     # resolving the spec before touching jax keeps bad input cheap
     from sphexa_tpu.tuning import (
         ReplaySpec, domains_for, make_entry, load_table, measure_candidate,
-        new_table, run_sweep, save_table, spec_from_manifest, upsert_entry,
+        new_table, run_sweep, save_table, spec_from_manifest,
+        static_cost_candidate, upsert_entry,
     )
 
     try:
@@ -127,6 +135,12 @@ def main(argv=None) -> int:
     counter = {"i": 0}
 
     def measure(knobs):
+        if args.objective.startswith("static-cost:"):
+            # chip-free: rank by the jaxcost roofline prediction of one
+            # phase — no steps run, no trace captured
+            return static_cost_candidate(
+                spec, knobs, args.objective.split(":", 1)[1],
+                device=args.cost_device)
         td = None
         if args.objective.startswith("phase:"):
             td = os.path.join(trace_root, f"cand{counter['i']}")

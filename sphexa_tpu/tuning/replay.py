@@ -172,3 +172,63 @@ def measure_candidate(spec: ReplaySpec, knobs: Dict, steps: int = 6,
         result["value"] = float(row["us"]) / max(done, 1)
         result["phase_us"] = float(row["us"])
     return result
+
+
+def static_cost_candidate(spec: ReplaySpec, knobs: Dict, phase: str,
+                          device: str = "v5e") -> Dict:
+    """Score one knob dict CHIP-FREE (``objective="static-cost:<phase>"``).
+
+    The candidate's knobs ride the same production ``tuned=`` path as
+    ``measure_candidate``, but instead of running steps the propagator
+    step is TRACED to a jaxpr and the value is the static roofline
+    prediction (jaxcost, devtools/audit/costmodel.py) of the target
+    phase's ms on the named device model — a sweep can rank candidates
+    on a machine with no accelerator at all. The ranking is only as
+    good as the cost model: run ``sphexa-telemetry trace <capture>
+    --predict`` against a real capture before trusting it
+    (docs/STATIC_ANALYSIS.md, calibration workflow).
+    """
+    import jax
+
+    from sphexa_tpu import propagator as prop
+    from sphexa_tpu.devtools.audit.costmodel import analyze_jaxpr, predict
+    from sphexa_tpu.simulation import Simulation
+
+    state, box, const = build_case(spec)
+    sim = Simulation(
+        state, box, const, prop=spec.prop, theta=spec.theta,
+        backend=spec.backend, num_devices=spec.devices,
+        tuned=dict(knobs) if knobs else None, workload=spec.case,
+    )
+    cfg, gtree = sim._cfg, sim._gtree
+    # one closure per propagator, mirroring the audit registry's step
+    # builders so the traced program IS the production step
+    steps = {
+        "std": lambda s, b: prop.step_hydro_std(s, b, cfg, gtree),
+        "ve": lambda s, b: prop.step_hydro_ve(s, b, cfg, gtree),
+        "nbody": lambda s, b: prop.step_nbody(s, b, cfg, gtree),
+        "turb-ve": lambda s, b: prop.step_turb_ve(
+            s, b, cfg, gtree, sim.turb_state, sim.turb_cfg),
+        "std-cooling": lambda s, b: prop.step_hydro_std_cooling(
+            s, b, cfg, gtree, sim.chem, sim.cooling_cfg),
+    }
+    if spec.prop not in steps:
+        raise ValueError(f"static-cost objective has no step builder for "
+                         f"prop {spec.prop!r} (has: {sorted(steps)})")
+    jaxpr = jax.make_jaxpr(steps[spec.prop])(sim.state, sim.box)
+    pred = predict(analyze_jaxpr(jaxpr), device)
+    row = pred.row(phase)
+    if row is None or row.ms <= 0:
+        raise ValueError(
+            f"phase {phase!r} absent from the static prediction (has: "
+            f"{[r.phase for r in pred.rows]})")
+    return {
+        "status": "ok",
+        "objective": f"static-cost:{phase}",
+        "value": row.ms,
+        "predicted_ms": row.ms,
+        "ai": row.ai,
+        "bound": row.bound,
+        "device": pred.device,
+        "steps": 0, "windows": 0, "rollbacks": 0, "reconfigures": 0,
+    }

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
 
 
-@entrypoint("bad_f64_cast", x64=True)  # expect: JXA101
+@entrypoint("bad_f64_cast", x64=True, phase_coverage_min=0.0)  # expect: JXA101
 def bad_f64_cast():
     def fn(x):
         return (x.astype(jnp.float64) * 2.0).sum()
@@ -19,7 +19,7 @@ def bad_f64_cast():
     return EntryCase(fn=fn, args=(jnp.zeros(8, jnp.float32),))
 
 
-@entrypoint("clean_f32", x64=True)
+@entrypoint("clean_f32", x64=True, phase_coverage_min=0.0)
 def clean_f32():
     def fn(x):
         return (x * 2.0).sum()

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
 
 
-@entrypoint("bad_dtype_carry")  # expect: JXA102
+@entrypoint("bad_dtype_carry", phase_coverage_min=0.0)  # expect: JXA102
 def bad_dtype_carry():
     def fn(x, t):
         return x * 2.0, (t + 1.0).astype(jnp.bfloat16)
@@ -25,7 +25,7 @@ def bad_dtype_carry():
     )
 
 
-@entrypoint("bad_weak_leak")  # expect: JXA102
+@entrypoint("bad_weak_leak", phase_coverage_min=0.0)  # expect: JXA102
 def bad_weak_leak():
     def fn(x, s):
         return x.sum(), s * 2.0
@@ -40,7 +40,7 @@ def bad_weak_leak():
     )
 
 
-@entrypoint("clean_normalized")
+@entrypoint("clean_normalized", phase_coverage_min=0.0)
 def clean_normalized():
     def fn(x, s):
         s = jnp.asarray(s, jnp.float32)  # boundary normalization

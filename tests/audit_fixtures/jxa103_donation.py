@@ -15,7 +15,7 @@ def _state():
     return {"x": jnp.zeros(16), "y": jnp.ones(16)}
 
 
-@entrypoint("undonated_state", donate=(0,))  # expect: JXA103
+@entrypoint("undonated_state", donate=(0,), phase_coverage_min=0.0)  # expect: JXA103
 def undonated_state():
     jitted = jax.jit(_step)
     args = (_state(), jnp.float32(2.0))
@@ -23,7 +23,7 @@ def undonated_state():
                      lower=lambda: jitted.lower(*args))
 
 
-@entrypoint("donated_state", donate=(0,))
+@entrypoint("donated_state", donate=(0,), phase_coverage_min=0.0)
 def donated_state():
     plain = jax.jit(_step)
     donated = jax.jit(_step, donate_argnums=(0,))

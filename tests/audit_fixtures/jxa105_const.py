@@ -9,7 +9,7 @@ from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
 _TABLE = np.arange(4096, dtype=np.float32)  # 16 KiB
 
 
-@entrypoint("baked_table", const_bytes_limit=1024)  # expect: JXA105
+@entrypoint("baked_table", const_bytes_limit=1024, phase_coverage_min=0.0)  # expect: JXA105
 def baked_table():
     def fn(x):
         return x + jnp.asarray(_TABLE)[: x.shape[0]]
@@ -17,7 +17,7 @@ def baked_table():
     return EntryCase(fn=fn, args=(jnp.zeros(4),))
 
 
-@entrypoint("table_as_argument", const_bytes_limit=1024)
+@entrypoint("table_as_argument", const_bytes_limit=1024, phase_coverage_min=0.0)
 def table_as_argument():
     def fn(x, table):
         return x + table[: x.shape[0]]

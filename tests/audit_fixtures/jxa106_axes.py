@@ -23,11 +23,11 @@ def _psum_fn():
     ))
 
 
-@entrypoint("wrong_axis_declaration", mesh_axes=("data",))  # expect: JXA106
+@entrypoint("wrong_axis_declaration", mesh_axes=("data",), phase_coverage_min=0.0)  # expect: JXA106
 def wrong_axis_declaration():
     return EntryCase(fn=_psum_fn(), args=(jnp.zeros(8),))
 
 
-@entrypoint("matching_axis_declaration", mesh_axes=("p",))
+@entrypoint("matching_axis_declaration", mesh_axes=("p",), phase_coverage_min=0.0)
 def matching_axis_declaration():
     return EntryCase(fn=_psum_fn(), args=(jnp.zeros(8),))

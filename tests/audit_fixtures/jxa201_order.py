@@ -34,13 +34,13 @@ def _stage_fn(chained: bool):
     ))
 
 
-@entrypoint("unchained_collectives", mesh_axes=("p",))  # expect: JXA201
+@entrypoint("unchained_collectives", mesh_axes=("p",), phase_coverage_min=0.0)  # expect: JXA201
 def unchained_collectives():
     return EntryCase(fn=_stage_fn(False),
                      args=(jnp.zeros(8), jnp.zeros(8)))
 
 
-@entrypoint("chained_collectives", mesh_axes=("p",))
+@entrypoint("chained_collectives", mesh_axes=("p",), phase_coverage_min=0.0)
 def chained_collectives():
     return EntryCase(fn=_stage_fn(True),
                      args=(jnp.zeros(8), jnp.zeros(8)))

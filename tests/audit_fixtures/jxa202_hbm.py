@@ -17,12 +17,12 @@ def _shift(x):
     return x + 1.0
 
 
-@entrypoint("undonated_over_budget", hbm_budget=_BUDGET)  # expect: JXA202
+@entrypoint("undonated_over_budget", hbm_budget=_BUDGET, phase_coverage_min=0.0)  # expect: JXA202
 def undonated_over_budget():
     return EntryCase(fn=_shift, args=(jnp.zeros(_N),))
 
 
-@entrypoint("donated_within_budget", donate=(0,), hbm_budget=_BUDGET)
+@entrypoint("donated_within_budget", donate=(0,), hbm_budget=_BUDGET, phase_coverage_min=0.0)
 def donated_within_budget():
     jitted = jax.jit(_shift, donate_argnums=0)
     x = jnp.zeros(_N)

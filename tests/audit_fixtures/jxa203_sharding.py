@@ -37,13 +37,13 @@ def _gather_fn(replicate: bool):
     ))
 
 
-@entrypoint("replicated_particle_operand", mesh_axes=("p",))  # expect: JXA203
+@entrypoint("replicated_particle_operand", mesh_axes=("p",), phase_coverage_min=0.0)  # expect: JXA203
 def replicated_particle_operand():
     return EntryCase(fn=_gather_fn(True),
                      args=(jnp.zeros(_N), jnp.zeros(_N)))
 
 
-@entrypoint("sharded_particle_operand", mesh_axes=("p",))
+@entrypoint("sharded_particle_operand", mesh_axes=("p",), phase_coverage_min=0.0)
 def sharded_particle_operand():
     return EntryCase(fn=_gather_fn(False),
                      args=(jnp.zeros(_N), jnp.zeros(_N)))
@@ -61,7 +61,7 @@ def _permute_fn():
     ))
 
 
-@entrypoint("volume_over_budget", mesh_axes=("p",))  # expect: JXA203
+@entrypoint("volume_over_budget", mesh_axes=("p",), phase_coverage_min=0.0)  # expect: JXA203
 def volume_over_budget():
     # the ppermute ships a full per-shard slab; the declared analytic
     # budget covers an eighth of it, slack included
@@ -70,7 +70,7 @@ def volume_over_budget():
                      exchange_slack=2.0)
 
 
-@entrypoint("volume_within_budget", mesh_axes=("p",))
+@entrypoint("volume_within_budget", mesh_axes=("p",), phase_coverage_min=0.0)
 def volume_within_budget():
     return EntryCase(fn=_permute_fn(), args=(jnp.zeros(_N),),
                      exchange_budget_bytes=(_N // 2) * 4,

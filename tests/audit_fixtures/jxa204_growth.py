@@ -1,0 +1,48 @@
+"""JXA204 fixtures: two-point growth probes over the rescale-exempt
+buffer class. The quadratic entry materializes an O(n^2) work buffer
+sized to dodge the extensive (slab-multiple) classification — exactly
+the superlinear-tree shape the round-10 caution warned JXA202's
+traced-size exemption would hide; the linear twin's scratch grows
+proportionally to N and passes."""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+_N, _N_GROWN = 12, 24             # a 2x N probe
+
+
+def _quad(x):
+    n = x.shape[0]
+    # n*n+1 elems: indivisible by both n and its pow2 padding, so the
+    # buffer lands in the rescale-EXEMPT class while growing O(n^2)
+    pair = jnp.zeros((n * n + 1,), jnp.float32) + x.sum()
+    return pair.sum() + x.sum()
+
+
+def _quad_case(n):
+    return EntryCase(fn=_quad, args=(jnp.zeros(n, jnp.float32),))
+
+
+@entrypoint("quadratic_scratch", phase_coverage_min=0.0)  # expect: JXA204
+def quadratic_scratch():
+    case = _quad_case(_N)
+    case.grow = lambda: (_quad_case(_N_GROWN), _N_GROWN / _N)
+    return case
+
+
+def _lin(x):
+    n = x.shape[0]
+    scratch = jnp.zeros((n + 1,), jnp.float32) + x.sum()
+    return scratch.sum() + x.sum()
+
+
+def _lin_case(n):
+    return EntryCase(fn=_lin, args=(jnp.zeros(n, jnp.float32),))
+
+
+@entrypoint("linear_scratch", phase_coverage_min=0.0)
+def linear_scratch():
+    case = _lin_case(_N)
+    case.grow = lambda: (_lin_case(_N_GROWN), _N_GROWN / _N)
+    return case

@@ -1,0 +1,43 @@
+"""JXA301 fixtures: phase-coverage over the cost model's attribution.
+The unscoped entry runs all its FLOPs outside any ``sphexa/<phase>``
+scope (coverage 0 under the default floor); the off-taxonomy entry
+stamps a scope the util/phases.py taxonomy does not know (flagged even
+with the floor waived); the scoped twin attributes fully and passes."""
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+from sphexa_tpu.util.phases import phase_scope
+
+_N = 4096
+
+
+def _unscoped(x):
+    return jnp.tanh(x) + 1.0
+
+
+@entrypoint("unscoped_step")  # expect: JXA301
+def unscoped_step():
+    return EntryCase(fn=_unscoped, args=(jnp.zeros(_N, jnp.float32),))
+
+
+def _off_taxonomy(x):
+    with jax.named_scope("sphexa/warpdrive"):
+        return jnp.tanh(x) + 1.0
+
+
+# floor waived: only the off-taxonomy scope itself is the violation
+@entrypoint("off_taxonomy_scope", phase_coverage_min=0.0)  # expect: JXA301
+def off_taxonomy_scope():
+    return EntryCase(fn=_off_taxonomy, args=(jnp.zeros(_N, jnp.float32),))
+
+
+def _scoped(x):
+    with phase_scope("density"):
+        return jnp.tanh(x) + 1.0
+
+
+@entrypoint("scoped_step")
+def scoped_step():
+    return EntryCase(fn=_scoped, args=(jnp.zeros(_N, jnp.float32),))

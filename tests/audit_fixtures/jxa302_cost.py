@@ -1,0 +1,42 @@
+"""JXA302 fixtures: predicted per-phase ms vs a committed budget file.
+The busted entry's sidecar (jxa302_budget.json) pins an absurdly low
+density ceiling; the missing-file entry DECLARES a budget that does not
+exist (a broken gate must be a finding, not a silent pass); the
+unbudgeted twin shares the sidecar but has no entry in it and passes."""
+
+import os
+
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+from sphexa_tpu.util.phases import phase_scope
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUDGET = os.path.join(_HERE, "jxa302_budget.json")
+_SIDE = 256
+
+
+def _dense(a, b):
+    with phase_scope("density"):
+        return a @ b
+
+
+def _args():
+    return (jnp.zeros((_SIDE, _SIDE), jnp.float32),
+            jnp.zeros((_SIDE, _SIDE), jnp.float32))
+
+
+@entrypoint("busted_budget", cost_budget_file=_BUDGET)  # expect: JXA302
+def busted_budget():
+    return EntryCase(fn=_dense, args=_args())
+
+
+@entrypoint("missing_budget",  # expect: JXA302
+            cost_budget_file=os.path.join(_HERE, "no_such_budget.json"))
+def missing_budget():
+    return EntryCase(fn=_dense, args=_args())
+
+
+@entrypoint("unbudgeted_entry", cost_budget_file=_BUDGET)
+def unbudgeted_entry():
+    return EntryCase(fn=_dense, args=_args())

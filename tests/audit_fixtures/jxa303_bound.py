@@ -1,0 +1,42 @@
+"""JXA303 fixtures: a phase DECLARED compute-bound must sit above the
+device ridge point. The streaming entry's density phase is a pure
+bandwidth-bound elementwise pass (AI << ridge) — the degraded-gather
+regression shape; the stale entry declares a phase its program never
+stamps; the dense twin's big dot really is compute-bound and passes."""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+from sphexa_tpu.util.phases import phase_scope
+
+_N = 1 << 16
+_SIDE = 768
+
+
+def _stream(x):
+    with phase_scope("density"):
+        return x * 2.0 + 1.0
+
+
+@entrypoint("claims_compute_bound",  # expect: JXA303
+            expect_compute_bound=("density",))
+def claims_compute_bound():
+    return EntryCase(fn=_stream, args=(jnp.zeros(_N, jnp.float32),))
+
+
+@entrypoint("stale_declaration",  # expect: JXA303
+            expect_compute_bound=("gravity-p2p",))
+def stale_declaration():
+    return EntryCase(fn=_stream, args=(jnp.zeros(_N, jnp.float32),))
+
+
+def _dense(a, b):
+    with phase_scope("density"):
+        return a @ b
+
+
+@entrypoint("really_compute_bound", expect_compute_bound=("density",))
+def really_compute_bound():
+    return EntryCase(fn=_dense,
+                     args=(jnp.zeros((_SIDE, _SIDE), jnp.float32),
+                           jnp.zeros((_SIDE, _SIDE), jnp.float32)))

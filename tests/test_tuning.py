@@ -393,3 +393,48 @@ class TestCli:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         table = load_table(os.path.join(root, "TUNING_TABLE.json"))
         assert validate_table(table) == []
+
+
+class TestStaticCostObjective:
+    """static-cost:<phase> — the chip-free sweep objective (jaxcost)."""
+
+    def test_candidate_scores_without_running_steps(self):
+        from sphexa_tpu.tuning import static_cost_candidate
+
+        spec = ReplaySpec(case="sedov", side=6, prop="std",
+                          backend="auto", theta=0.5, devices=None)
+        rec = static_cost_candidate(spec, {"target_block": 64},
+                                    "density", device="v5e")
+        assert rec["status"] == "ok"
+        assert rec["objective"] == "static-cost:density"
+        assert rec["value"] > 0
+        assert rec["value"] == rec["predicted_ms"]
+        assert rec["bound"] in ("compute", "memory", "ici")
+        assert rec["steps"] == 0          # nothing executed, only traced
+
+    def test_unknown_phase_raises(self):
+        from sphexa_tpu.tuning import static_cost_candidate
+
+        spec = ReplaySpec(case="sedov", side=6, prop="std",
+                          backend="auto", theta=0.5, devices=None)
+        with pytest.raises(ValueError):
+            static_cost_candidate(spec, {}, "warpdrive")
+
+    def test_cli_micro_sweep_emits_valid_v5_events(self, tmp_path):
+        from sphexa_tpu.tuning.cli import main
+
+        out = tmp_path / "sweep"
+        rc = main(["--case", "sedov", "--side", "6",
+                   "--knobs", "target_block", "--budget", "2",
+                   "--objective", "static-cost:density",
+                   "--out", str(out), "--quiet"])
+        assert rc == 0
+        events = [json.loads(line) for line in
+                  (out / "events.jsonl").read_text().splitlines()]
+        sweeps = [e for e in events if e.get("kind") == "sweep"]
+        assert len(sweeps) == 2
+        for e in sweeps:
+            assert validate_event(e) == []
+            assert e["status"] == "ok"
+            assert e["objective"] == "static-cost:density"
+            assert e["value"] > 0
