@@ -304,6 +304,47 @@ EOF
     fi
 }
 
+run_blockdt() {
+    echo "== block-dt smoke (5-step two-scale run -> schema-v6 dt_bins gate) =="
+    local dir rc
+    dir=$(mktemp -d)
+    # sedov IS the two-scale case (hot core, cold ambient); a full B=4
+    # cycle (8 substeps) so the deep bins come due and the updates-saved
+    # factor is well-defined in the flush event
+    env JAX_PLATFORMS=cpu python -m sphexa_tpu.app.main \
+        --init sedov -n 8 -s 8 --quiet \
+        --dt-bins 4 --bin-resort-drift 0.01 --check-every 4 \
+        --telemetry-dir "$dir/run" -o "$dir/out"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "block-dt smoke run failed (rc=$rc)"
+        rm -rf "$dir"
+        exit $rc
+    fi
+    # --strict: the v6 dt_bins events must validate against the schema
+    python -m sphexa_tpu.telemetry summary "$dir/run" --strict
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "strict schema validation failed on the block-dt run"
+        echo "(rc=$rc): the schema-v6 dt_bins event drifted from the"
+        echo "registry (docs/OBSERVABILITY.md, telemetry/registry.py)."
+        exit $rc
+    fi
+    # the science view must RENDER the bin histogram (grep is the gate:
+    # science exits 0 on any physics rows, the table is v6-specific)
+    python -m sphexa_tpu.telemetry science "$dir/run" | tee "$dir/sci.txt"
+    rc=$?
+    if [ $rc -ne 0 ] || ! grep -q "dt bins" "$dir/sci.txt"; then
+        rm -rf "$dir"
+        echo "sphexa-telemetry science lost the dt-bins histogram"
+        echo "(rc=$rc): the dt_bins flush event or its science view"
+        echo "broke (simulation._emit_blockdt, telemetry/cli.py)."
+        exit 1
+    fi
+    rm -rf "$dir"
+}
+
 run_multichip_diff() {
     echo "== multi-chip comm-volume gate (measure_multichip --quick vs baseline) =="
     local tmp rc
@@ -361,6 +402,10 @@ case "${1:-}" in
         run_tuning
         exit 0
         ;;
+    --blockdt-only)
+        run_blockdt
+        exit 0
+        ;;
 esac
 
 run_lint
@@ -370,6 +415,7 @@ run_cost
 run_telemetry
 run_history
 run_tuning
+run_blockdt
 run_multichip_diff
 
 echo "== tier-1 tests (fast tier, CPU) =="

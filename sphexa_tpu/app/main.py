@@ -101,6 +101,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "in one batch at the window end (default 1 = "
                         "synchronous; unset, --tuned may resolve it "
                         "from the tuning table)")
+    p.add_argument("--dt-bins", type=int, default=None, dest="dt_bins",
+                   help="hierarchical block time steps: number of "
+                        "power-of-two per-particle dt bins (std/ve "
+                        "propagators; unset = the global-dt path, 1 = "
+                        "bitwise-identical to it; docs/OBSERVABILITY.md "
+                        "schema v6)")
+    p.add_argument("--bin-sync-every", type=int, default=None,
+                   dest="bin_sync_every",
+                   help="cycles between bin reassignments at the sync "
+                        "substep (block-dt mode; default 1)")
+    p.add_argument("--bin-resort-drift", type=float, default=None,
+                   dest="bin_resort_drift",
+                   help="drift-aware resort threshold: keep the current "
+                        "particle order while folded-key inversions stay "
+                        "under this fraction of n (block-dt mode; "
+                        "default 0 = resort on any inversion)")
     p.add_argument("--tuned", default=None,
                    help="resolve engine knobs through a committed tuning "
                         "table (docs/TUNING.md): 'auto' = the repo's "
@@ -335,6 +351,9 @@ def main(argv=None) -> int:
                          num_devices=args.devices, halo_mode=args.halo_mode,
                          backend=args.backend,
                          check_every=args.check_every,
+                         dt_bins=args.dt_bins,
+                         bin_sync_every=args.bin_sync_every,
+                         bin_resort_drift=args.bin_resort_drift,
                          imbalance_ratio=args.imbalance_ratio,
                          obs_spec=obs_spec, science_rows=True,
                          drift_budget=args.drift_budget,

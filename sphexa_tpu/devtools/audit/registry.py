@@ -445,6 +445,63 @@ def step_std_sharded():
 
 
 # ---------------------------------------------------------------------------
+# hierarchical block-timestep step (sph/blockdt.py): the std builder with
+# per-particle Δt bins — audited at dt_bins=4 so the fold-key sort, the
+# drift-aware resort cond, the due-mask compaction and the masked
+# integrate all appear in the traced program (JXA301 covers the new
+# sphexa/dt-bins taxonomy phase; the sharded twin holds the JXA201
+# collective-order rule over the unchanged force-stage exchange)
+# ---------------------------------------------------------------------------
+
+
+@entrypoint("step_std_blockdt", donate=(0,))
+def step_std_blockdt():
+    from sphexa_tpu import propagator as prop
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.simulation import Simulation
+
+    state, box, const = make_initializer("sedov")(_SIDE)
+    sim = Simulation(state, box, const, prop="std", dt_bins=4,
+                     bin_resort_drift=0.01)
+    cfg, bst = sim._cfg, sim._bstate
+    state, box = sim.state, sim.box
+    return EntryCase(
+        fn=lambda s, b, bd: prop.step_hydro_std_blockdt(
+            s, b, cfg, None, bd),
+        args=(state, box, bst),
+        lower=lambda: prop.step_hydro_std_blockdt_donated.lower(
+            state, box, cfg, None, bst),
+        carry=lambda a, out: (out[0], out[1], out[3]),
+    )
+
+
+@entrypoint("step_std_blockdt_sharded", mesh_axes=("p",))
+def step_std_blockdt_sharded():
+    from sphexa_tpu import propagator as prop
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.simulation import Simulation
+
+    P, side = _mesh_size_and_side()
+    state, box, const = make_initializer("sedov")(side)
+    sim = Simulation(state, box, const, prop="std", backend="pallas",
+                     num_devices=P, dt_bins=4)
+    hi = sim._halo_info
+    # same config mirror as step_std_sharded: the audited trace IS the
+    # stepper's program, without its device_put re-sharding prologue
+    cfg_sh = dataclasses.replace(
+        sim._cfg, mesh=sim._mesh, shard_axis="p",
+        halo_window=(hi["wmax"] if hi["mode"] == "windowed" else 0),
+        halo_cells=tuple(hi.get("caps", ())),
+    )
+    return EntryCase(
+        fn=lambda s, b, bd: prop.step_hydro_std_blockdt(
+            s, b, cfg_sh, None, bd),
+        args=(sim.state, sim.box, sim._bstate),
+        exchange_budget_bytes=hi["bytes_per_step"] + _EXCHANGE_HEADROOM,
+    )
+
+
+# ---------------------------------------------------------------------------
 # in-graph observable ledger (observables/ledger.py) — the science
 # reductions every step tail runs; audited standalone so JXA101 (dtype)
 # and JXA104 (host boundary) hold the ledger itself, single-device and

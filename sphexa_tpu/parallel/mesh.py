@@ -89,12 +89,20 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
     ride the slab sharding + the in-step SFC sort.
     """
     from sphexa_tpu.propagator import (
+        step_hydro_std_blockdt,
         step_hydro_std_cooling,
         step_hydro_ve,
+        step_hydro_ve_blockdt,
         step_turb_ve,
     )
 
     aux_props = {step_turb_ve, step_hydro_std_cooling}
+    # blockdt steps carry the BlockDtState through the aux slot (4-tuple
+    # return like aux_props) but take no static aux_cfg; their bin math
+    # runs OUTSIDE shard_map on GSPMD-sharded arrays, so the pallas force
+    # stages and their pinned collective order are reused unchanged
+    blockdt_props = {step_hydro_std_blockdt, step_hydro_ve_blockdt}
+    carry_props = aux_props | blockdt_props
     # GSPMD has no auto-partitioning rule for Mosaic (pallas) custom calls,
     # so the pallas pair stage runs under an explicit shard_map: each
     # device executes the fused engine on its SFC slab with windowed
@@ -104,7 +112,7 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
     # plain XLA on sharded arrays, which GSPMD partitions. The nbody step
     # has no pair stage — it falls back to the GSPMD XLA gravity path.
     if cfg.backend == "pallas":
-        if step_fn in ({step_hydro_std, step_hydro_ve} | aux_props):
+        if step_fn in ({step_hydro_std, step_hydro_ve} | carry_props):
             cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p",
                                       halo_window=halo_window,
                                       halo_cells=tuple(halo_cells))
@@ -131,6 +139,8 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
             new_state, new_box, diag, new_aux = step_fn(
                 s, b, cfg, gtree, aux, aux_cfg
             )
+        elif step_fn in blockdt_props:
+            new_state, new_box, diag, new_aux = step_fn(s, b, cfg, gtree, aux)
         else:
             new_state, new_box, diag = step_fn(s, b, cfg, gtree)
             new_aux = None
@@ -174,7 +184,7 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
                 aux,
             )
         out = jitted(s, b, gtree, aux)
-        return out if step_fn in aux_props else out[:3]
+        return out if step_fn in carry_props else out[:3]
 
     # expose the underlying jit cache so the Simulation's compile
     # watchdog (telemetry retrace events) can probe sharded launches too;

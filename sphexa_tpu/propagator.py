@@ -29,8 +29,9 @@ from sphexa_tpu.observables.ledger import (
     ObservableSpec,
     ledger_diagnostics,
 )
-from sphexa_tpu.sfc.box import Box, make_global_box
+from sphexa_tpu.sfc.box import Box, make_global_box, put_in_box
 from sphexa_tpu.sfc.keys import compute_sfc_keys
+from sphexa_tpu.sph import blockdt as bdt
 from sphexa_tpu.sph import hydro_std, hydro_ve
 from sphexa_tpu.sph.kernels import update_h
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
@@ -83,6 +84,14 @@ SHARD_DIAG_KEYS = ("shard_rows", "shard_occ", "shard_work", "shard_trips")
 #: combines them, timestep.hpp:97-112). One global order across all
 #: propagators; inactive candidates rank as +inf.
 DT_LIMITERS = ("growth", "courant", "rho", "cool", "accel")
+
+#: block-timestep diagnostics the *_blockdt step builders ride alongside
+#: STEP_DIAG_KEYS (consumers must .get() them): active-row count, the
+#: (dt_bins,) bin occupancy histogram, the substep just executed, the
+#: drift-aware resort decision + its inversion count, and the
+#: active-rows neighbor-work proxy gathered through the compaction list.
+BLOCKDT_DIAG_KEYS = ("bdt_active", "bdt_pop", "bdt_substep", "bdt_resort",
+                     "bdt_drift", "bdt_work")
 
 
 def _dt_limiter(min_dt_prev, const: SimConstants, courant=None, rho=None,
@@ -163,24 +172,48 @@ class PropagatorConfig:
     # Verlet skin as a fraction of the 2*h_max search radius: larger =
     # fewer rebuilds but more candidate lanes per target
     list_skin_rel: float = 0.2
+    # hierarchical block time steps (sph/blockdt.py): number of
+    # power-of-two Δt bins the *_blockdt step builders use. None = the
+    # global-dt path, bitwise unchanged (the field is never read outside
+    # the blockdt builders); 1 = blockdt machinery with every particle
+    # due every substep, pinned bitwise-equal to the global path
+    dt_bins: Optional[int] = None
+    # re-bin cadence in CYCLES at the sync substep (1 = every cycle);
+    # larger amortizes the bin assignment at the cost of staler bins
+    bin_sync_every: int = 1
+    # drift-aware resort threshold: the blockdt sort keeps the incoming
+    # order when the folded-key inversion count is <= this fraction of n
+    # (0.0 = keep only when already perfectly sorted — exact)
+    bin_resort_drift: float = 0.0
 
 
-def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
+def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None,
+                  bins=None, resort_drift: float = 0.0):
     """Global SFC sort: the analog of domain.sync()'s keygen + radix sort
     (cstone/domain/assignment.hpp:84-122). Every field array is gathered
     into key order; scalars pass through untouched. ``aux``: an optional
     extra pytree of per-particle arrays (e.g. ChemistryData) permuted
     identically so it stays aligned with the persisted sorted state.
+
+    ``bins``: block-timestep path — the bin index is folded below the
+    spatial bits (blockdt.fold_bin_key) so one argsort groups equal-key
+    particles by bin, and the permute goes DRIFT-AWARE: a cheap in-graph
+    inversion count over the folded keys decides resort-now vs keep
+    (``resort_drift`` = tolerated inversion fraction; ROADMAP item 2b —
+    fixed resort cadence measured net-negative, the check is the new
+    idea). Returns ``(state, keys, aux, resorted, inversions)``; the
+    plain path keeps its 3-tuple and its lowering byte-identical.
     """
     # sphexa/sort: the whole keygen + argsort + permute program is one
     # attribution phase (profiler traces; util/phases.py taxonomy)
     with phase_scope("sort"):
         keys = compute_sfc_keys(state.x, state.y, state.z, box, curve=curve)
-        order = jnp.argsort(keys)
-        sorted_keys = keys[order]
+        if bins is None:
+            order = jnp.argsort(keys)
+            sorted_keys = keys[order]
     n = state.n
 
-    def permute_tree(tree):
+    def permute_tree(tree, order):
         """Permute every (n,) leaf. Same-dtype leaves are stacked into one
         (n, F) matrix and gathered by ROW: XLA's TPU gather moves F
         contiguous elements per index, ~18x faster than F separate 1-D
@@ -202,8 +235,32 @@ def _sort_by_keys(state: ParticleState, box: Box, curve: str, aux=None):
                 leaves[i] = mat[:, k]
         return jax.tree.unflatten(treedef, leaves)
 
+    if bins is None:
+        with phase_scope("sort"):
+            return (permute_tree(state, order), sorted_keys,
+                    permute_tree(aux, order))
+
+    with phase_scope("dt-bins"):
+        skey = bdt.fold_bin_key(keys, bins)
+        inv = jnp.sum((skey[1:] < skey[:-1]).astype(jnp.int32))
+        # static threshold: resort_drift and n are trace-time constants
+        resort = inv > jnp.int32(int(resort_drift * n))
+
+    def do_resort(state, keys, aux):
+        with phase_scope("sort"):
+            order = jnp.argsort(skey)
+            return permute_tree(state, order), keys[order], \
+                permute_tree(aux, order)
+
+    def keep(state, keys, aux):
+        return state, keys, aux
+
+    # only the taken branch executes at runtime — the keep branch skips
+    # the whole argsort + row-gather program, which is the entire point
     with phase_scope("sort"):
-        return permute_tree(state), sorted_keys, permute_tree(aux)
+        state, keys, aux = jax.lax.cond(resort, do_resort, keep,
+                                        state, keys, aux)
+    return state, keys, aux, resort.astype(jnp.int32), inv
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -667,10 +724,17 @@ def _ve_forces_sharded(state, box, cfg: PropagatorConfig, keys):
     return out
 
 
-def _force_stage_prologue(state, box, cfg: PropagatorConfig, lists, aux=None):
+def _force_stage_prologue(state, box, cfg: PropagatorConfig, lists, aux=None,
+                          keys=None):
     """Shared head of the force stages: list mode (frozen order, validity
     diagnostics) vs per-step box regrow + global sort. Returns
-    (state, box, keys, ldiag, aux); keys is None in list mode."""
+    (state, box, keys, ldiag, aux); keys is None in list mode.
+
+    ``keys`` non-None: the caller already regrew the box and sorted (the
+    blockdt builders run the bin-folded drift-aware sort themselves) —
+    pass everything through untouched."""
+    if keys is not None:
+        return state, box, keys, None, aux
     if lists is not None:
         from sphexa_tpu.sph.pair_lists import list_slack
 
@@ -693,7 +757,7 @@ def _force_stage_prologue(state, box, cfg: PropagatorConfig, lists, aux=None):
 
 def _std_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree], aux=None, lists=None,
+    gtree: Optional[GravityTree], aux=None, lists=None, keys=None,
 ):
     """The std-SPH force stage shared by the plain and cooling propagators
     (HydroProp::computeForces, std_hydro.hpp:123-157): box regrow -> sort ->
@@ -708,7 +772,7 @@ def _std_forces(
     replayed by the driver, like a cap overflow)."""
     const = cfg.const
     state, box, keys, ldiag, aux = _force_stage_prologue(
-        state, box, cfg, lists, aux
+        state, box, cfg, lists, aux, keys=keys
     )
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
 
@@ -859,7 +923,8 @@ def _split_dvout(dvout, av_clean: bool):
 
 def _ve_forces(
     state: ParticleState, box: Box, cfg: PropagatorConfig,
-    gtree: Optional[GravityTree], lists=None,
+    gtree: Optional[GravityTree], lists=None, keys=None,
+    raw_dts: bool = False,
 ):
     """The VE force stage shared by the plain and turbulence-stirred
     propagators (HydroVeProp::computeForces, ve_hydro.hpp:131-208):
@@ -870,7 +935,7 @@ def _ve_forces(
     """
     const = cfg.const
     state, box, keys, ldiag, _ = _force_stage_prologue(
-        state, box, cfg, lists
+        state, box, cfg, lists, keys=keys
     )
     x, y, z, h, m = state.x, state.y, state.z, state.h, state.m
     vx, vy, vz = state.vx, state.vy, state.vz
@@ -972,6 +1037,11 @@ def _ve_forces(
     if sdiag is not None:
         gdiag = {**(gdiag or {}), **sdiag}
 
+    if raw_dts:
+        # blockdt builders combine the candidates themselves (only at
+        # the sync substep); hand them back uncombined in the dt slot
+        return (state, box, ax, ay, az, du, (dt_courant, dt_rho, extra_dts),
+                alpha, nc, occ, rho, c, gdiag)
     with phase_scope("timestep"):
         dt = compute_timestep(state.min_dt, dt_courant, dt_rho, *extra_dts,
                               const=const)
@@ -1057,6 +1127,225 @@ def _step_nbody(
 
 
 # ---------------------------------------------------------------------------
+# hierarchical block time steps (sph/blockdt.py)
+# ---------------------------------------------------------------------------
+
+
+def _integrate_and_finish_blockdt(
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    ax, ay, az, du, dt_min, dt_prev, due, bins, dt_eff, nc, occ, rho,
+    extra=None, extra_diag=None, c=None, dt_limiter=None,
+):
+    """Block-timestep twin of _integrate_and_finish: the Press update is
+    evaluated with PER-PARTICLE dt arrays (compute_positions is fully
+    elementwise in dt/dt_m1) and applied to DUE rows only; inactive rows
+    get the KDK-consistent drift ``x += v * dt_min`` (PBC-folded) with
+    every other field frozen.  Due rows first rebase away the drift
+    accumulated since their last kick, so the update runs from the
+    kick-time position with the full ``dt_eff = dt_min * 2**k``.
+
+    The conservation ledger still runs over ALL rows (deviation from the
+    ISSUE's active-rows wording, by design: the energy totals need the
+    frozen rows' contributions every substep — the active-rows saving is
+    the UPDATE reduction, which is exactly what bdt_active records).
+    """
+    const = cfg.const
+    with phase_scope("integrate"):
+        # bins>0 gate: at k=0 the rebase term is exactly zero, but
+        # a - 0.0 is not a bitwise identity for a = -0.0 and dt_bins=1
+        # pins bitwise equality with the global path
+        rebase = due & (bins > 0)
+        dr = dt_eff - dt_min
+        bx = jnp.where(rebase, state.x - state.vx * dr, state.x)
+        by = jnp.where(rebase, state.y - state.vy * dr, state.y)
+        bz = jnp.where(rebase, state.z - state.vz * dr, state.z)
+        fields = (bx, by, bz, state.x_m1, state.y_m1, state.z_m1,
+                  state.vx, state.vy, state.vz, state.h,
+                  state.temp, state.temp_lo, du, state.du_m1)
+        (nx, ny, nz, dxm, dym, dzm, vx, vy, vz, h, temp, temp_lo, ndu,
+         du_m1) = compute_positions(
+            fields, ax, ay, az, dt_eff, dt_prev, box, const
+        )
+        drift = put_in_box(box, jnp.stack(
+            [state.x + state.vx * dt_min,
+             state.y + state.vy * dt_min,
+             state.z + state.vz * dt_min], axis=-1))
+        sel = lambda a, b: jnp.where(due, a, b)
+        new_h = sel(update_h(const.ng0, nc + 1, h), state.h)
+        new_state = dataclasses.replace(
+            state,
+            x=sel(nx, drift[:, 0]), y=sel(ny, drift[:, 1]),
+            z=sel(nz, drift[:, 2]),
+            x_m1=sel(dxm, state.x_m1), y_m1=sel(dym, state.y_m1),
+            z_m1=sel(dzm, state.z_m1),
+            vx=sel(vx, state.vx), vy=sel(vy, state.vy),
+            vz=sel(vz, state.vz),
+            h=new_h, temp=sel(temp, state.temp),
+            temp_lo=sel(temp_lo, state.temp_lo),
+            du=sel(ndu, state.du), du_m1=sel(du_m1, state.du_m1),
+            ttot=state.ttot + dt_min, min_dt=dt_min,
+            min_dt_m1=state.min_dt,
+            **(extra or {}),
+        )
+        diagnostics = {
+            "dt": dt_min,
+            "nc_mean": jnp.mean(nc.astype(jnp.float32)) + 1.0,
+            "nc_max": jnp.max(nc) + 1,
+            "occupancy": occ,
+            "rho_max": jnp.max(rho),
+            "h_max": jnp.max(new_h),
+        }
+    if cfg.obs is not None:
+        ed = extra_diag or {}
+        diagnostics.update(ledger_diagnostics(
+            new_state, rho, nc, const, cfg.nbr.ngmax, spec=cfg.obs,
+            egrav=ed.get("egrav", 0.0), box=box, c=c,
+            smoothing=True,
+            token=ed.get("shard_trips"),
+        ))
+    if dt_limiter is not None:
+        diagnostics["dt_limiter"] = dt_limiter
+    if cfg.keep_accels:
+        diagnostics.update({"ax": ax, "ay": ay, "az": az})
+    if cfg.keep_fields:
+        diagnostics["rho"] = rho
+        diagnostics["c"] = c if c is not None else jnp.zeros_like(rho)
+    diagnostics.update(extra_diag or {})
+    return new_state, box, diagnostics
+
+
+def _blockdt_prologue(state, box, cfg: PropagatorConfig, bst):
+    """Box regrow + the blockdt sort.  dt_bins = 1 routes through the
+    PLAIN _sort_by_keys call (no fold, no resort cond) so the whole step
+    stays bitwise-identical to the global-dt path; deeper stacks get the
+    bin-folded drift-aware sort.  The BlockDtState rides the aux channel
+    (its (n,) leaves permute, its scalars pass through)."""
+    with phase_scope("sort"):
+        box = make_global_box(state.x, state.y, state.z, box)
+    if cfg.dt_bins == 1:
+        state, keys, bst = _sort_by_keys(state, box, cfg.curve, aux=bst)
+        return state, box, keys, bst, jnp.int32(1), jnp.int32(0)
+    state, keys, bst, resorted, inv = _sort_by_keys(
+        state, box, cfg.curve, aux=bst, bins=bst.bins,
+        resort_drift=cfg.bin_resort_drift)
+    return state, box, keys, bst, resorted, inv
+
+
+def _blockdt_tail(state, box, cfg: PropagatorConfig, ax, ay, az, du,
+                  dt_sync, bst, resorted, inv, nc, occ, rho, c=None,
+                  dt_limiter=None, gdiag=None, alpha=None):
+    """Shared bin bookkeeping + due-rows integration of the blockdt step
+    builders: sync-substep dt_min/bin refresh, due mask, bitmask-rank
+    active compaction, BlockDtState advance, then the blockdt integrate
+    tail.  All of it is elementwise or global-reduction math OUTSIDE
+    shard_map — on mesh runs GSPMD partitions it and the shard_map
+    collective order the JXA201 rule pins is untouched."""
+    const = cfg.const
+    B = cfg.dt_bins
+    C = bdt.cycle_length(B)
+    with phase_scope("dt-bins"):
+        is_sync = bst.substep == 0
+        dt_min = jnp.where(is_sync, dt_sync, bst.dt_min)
+        grav = cfg.gravity is not None
+        cand = bdt.particle_dt_candidates(
+            state.h, c, const,
+            ax=ax if grav else None, ay=ay if grav else None,
+            az=az if grav else None)
+        rebin = is_sync & (bst.cycle % cfg.bin_sync_every == 0)
+        bins = jnp.where(rebin, bdt.assign_bins(cand, dt_min, B), bst.bins)
+        due = bdt.due_mask(bins, bst.substep)
+        # exact power-of-two scale: integer shift -> f32 (exp2 may not
+        # hit integer points exactly on every backend; 1 << k does)
+        dt_eff = dt_min * jnp.left_shift(1, bins).astype(jnp.float32)
+        use_kernel = cfg.backend == "pallas" and cfg.shard_axis is None
+        idx_act, n_active = bdt.compact_active(
+            due, use_kernel=use_kernel, interpret=_pallas_interpret())
+        pop = bdt.bin_populations(bins, B)
+        lane = jnp.arange(state.n, dtype=jnp.int32)
+        work = jnp.sum(jnp.where(lane < n_active,
+                                 nc[idx_act], 0).astype(jnp.float32))
+        bdiag = {"bdt_active": n_active, "bdt_pop": pop,
+                 "bdt_substep": bst.substep, "bdt_resort": resorted,
+                 "bdt_drift": inv, "bdt_work": work}
+        wrap = bst.substep + 1 >= C
+        new_bst = dataclasses.replace(
+            bst, bins=bins,
+            dt_prev=jnp.where(due, dt_eff, bst.dt_prev),
+            substep=jnp.where(wrap, 0, bst.substep + 1),
+            cycle=bst.cycle + wrap.astype(jnp.int32),
+            dt_min=dt_min)
+    extra = None if alpha is None else {
+        "alpha": jnp.where(due, alpha, state.alpha)}
+    # B == 1: feed compute_positions the SCALARS the global path feeds it
+    # — a broadcast (n,) operand changes XLA's FMA formation and would
+    # break the bitwise dt_bins=1 pin even at identical values
+    if B == 1:
+        cp_dt, cp_dtm1 = dt_min, state.min_dt
+    else:
+        cp_dt, cp_dtm1 = dt_eff, bst.dt_prev
+    new_state, box, diag = _integrate_and_finish_blockdt(
+        state, box, cfg, ax, ay, az, du, dt_min, cp_dtm1, due, bins,
+        cp_dt, nc, occ, rho, extra=extra,
+        extra_diag={**(gdiag or {}), **bdiag}, c=c, dt_limiter=dt_limiter)
+    return new_state, box, diag, new_bst
+
+
+def _step_hydro_std_blockdt(
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree] = None, bst=None,
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array], object]:
+    """One std-SPH step under hierarchical block time steps (Bonsai's
+    block scheme, Bédorf et al. 2014 §3.4; sph/blockdt.py).
+
+    Bin-folded drift-aware sort -> full-shape force sweep (inactive
+    particles are sources at drifted positions; the fixed-shape engines
+    are untouched) -> sync-substep dt_min refresh + re-binning -> active
+    compaction -> due-rows-only integration.  The update REDUCTION is
+    what bdt_active/bdt_pop record — the chip-free complexity proxy
+    (docs/NEXT.md round 12).  Returns (state, box, diagnostics, bst).
+    """
+    const = cfg.const
+    state, box, keys, bst, resorted, inv = _blockdt_prologue(
+        state, box, cfg, bst)
+    (state, box, ax, ay, az, du, dt_courant, extra_dts, nc, occ, rho, c,
+     gdiag, _) = _std_forces(state, box, cfg, gtree, keys=keys)
+    with phase_scope("timestep"):
+        dt_sync = compute_timestep(state.min_dt, dt_courant, *extra_dts,
+                                   const=const)
+        limiter = _dt_limiter(state.min_dt, const, courant=dt_courant,
+                              accel=extra_dts[0] if extra_dts else None)
+    return _blockdt_tail(state, box, cfg, ax, ay, az, du, dt_sync, bst,
+                         resorted, inv, nc, occ, rho, c=c,
+                         dt_limiter=limiter, gdiag=gdiag)
+
+
+def _step_hydro_ve_blockdt(
+    state: ParticleState, box: Box, cfg: PropagatorConfig,
+    gtree: Optional[GravityTree] = None, bst=None,
+) -> Tuple[ParticleState, Box, Dict[str, jax.Array], object]:
+    """One VE-SPH step under hierarchical block time steps — the same
+    scheme as _step_hydro_std_blockdt over the VE force stage (raw dt
+    candidates; the sync-substep combination below is the same
+    compute_timestep expression the global ve path uses).  AV alpha
+    freezes on inactive rows like every other evolved field."""
+    const = cfg.const
+    state, box, keys, bst, resorted, inv = _blockdt_prologue(
+        state, box, cfg, bst)
+    (state, box, ax, ay, az, du, (dt_courant, dt_rho, extra_dts), alpha,
+     nc, occ, rho, c, gdiag) = _ve_forces(
+        state, box, cfg, gtree, keys=keys, raw_dts=True)
+    with phase_scope("timestep"):
+        dt_sync = compute_timestep(state.min_dt, dt_courant, dt_rho,
+                                   *extra_dts, const=const)
+        limiter = _dt_limiter(state.min_dt, const, courant=dt_courant,
+                              rho=dt_rho,
+                              accel=extra_dts[0] if extra_dts else None)
+    return _blockdt_tail(state, box, cfg, ax, ay, az, du, dt_sync, bst,
+                         resorted, inv, nc, occ, rho, c=c,
+                         dt_limiter=limiter, gdiag=gdiag, alpha=alpha)
+
+
+# ---------------------------------------------------------------------------
 # jitted step variants
 # ---------------------------------------------------------------------------
 # Every step builder ships as a PAIR of jits over the same impl:
@@ -1090,3 +1379,9 @@ step_hydro_ve, step_hydro_ve_donated = _step_pair(
 step_turb_ve, step_turb_ve_donated = _step_pair(
     _step_turb_ve, ("cfg", "turb_cfg"))
 step_nbody, step_nbody_donated = _step_pair(_step_nbody, ("cfg",))
+# blockdt pairs donate the ParticleState only: the BlockDtState carry is
+# small and the rollback window keeps the SAME object across a replay
+step_hydro_std_blockdt, step_hydro_std_blockdt_donated = _step_pair(
+    _step_hydro_std_blockdt, ("cfg",))
+step_hydro_ve_blockdt, step_hydro_ve_blockdt_donated = _step_pair(
+    _step_hydro_ve_blockdt, ("cfg",))

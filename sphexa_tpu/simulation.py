@@ -26,10 +26,14 @@ from sphexa_tpu.neighbors.cell_list import (
 from sphexa_tpu.propagator import (
     PropagatorConfig,
     step_hydro_std,
+    step_hydro_std_blockdt,
+    step_hydro_std_blockdt_donated,
     step_hydro_std_cooling,
     step_hydro_std_cooling_donated,
     step_hydro_std_donated,
     step_hydro_ve,
+    step_hydro_ve_blockdt,
+    step_hydro_ve_blockdt_donated,
     step_hydro_ve_donated,
     step_nbody,
     step_nbody_donated,
@@ -37,6 +41,7 @@ from sphexa_tpu.propagator import (
     step_turb_ve_donated,
 )
 from sphexa_tpu.sfc.box import BoundaryType, Box
+from sphexa_tpu.sph.blockdt import make_blockdt_state
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
 
 _PROPAGATORS: Dict[str, Callable] = {
@@ -57,6 +62,19 @@ _PROPAGATORS_DONATED: Dict[str, Callable] = {
     "nbody": step_nbody_donated,
     "turb-ve": step_turb_ve_donated,
     "std-cooling": step_hydro_std_cooling_donated,
+}
+
+# hierarchical block-timestep twins (Simulation(dt_bins=...)): the std/ve
+# builders that carry a BlockDtState through the aux slot and return a
+# 4-tuple; the donated variants consume the ParticleState ONLY, so the
+# carry is safe to pin by reference for window rollback
+_PROPAGATORS_BLOCKDT: Dict[str, Callable] = {
+    "std": step_hydro_std_blockdt,
+    "ve": step_hydro_ve_blockdt,
+}
+_PROPAGATORS_BLOCKDT_DONATED: Dict[str, Callable] = {
+    "std": step_hydro_std_blockdt_donated,
+    "ve": step_hydro_ve_blockdt_donated,
 }
 
 
@@ -84,6 +102,9 @@ def make_propagator_config(
     obs_spec=None,
     tuned: object = None,
     workload: Optional[str] = None,
+    dt_bins: Optional[int] = None,
+    bin_sync_every: int = 1,
+    bin_resort_drift: float = 0.0,
 ) -> PropagatorConfig:
     """Size the static neighbor-search config from the current particle
     distribution (single source of truth — used by Simulation, tests and
@@ -235,6 +256,8 @@ def make_propagator_config(
         const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean,
         keep_accels=keep_accels, keep_fields=keep_fields, backend=backend,
         list_slot_cap=slot_cap, list_skin_rel=list_skin_rel, obs=obs_spec,
+        dt_bins=dt_bins, bin_sync_every=bin_sync_every,
+        bin_resort_drift=bin_resort_drift,
     )
 
 
@@ -295,6 +318,9 @@ class Simulation:
         science_rows: bool = False,
         tuned: object = None,
         workload: Optional[str] = None,
+        dt_bins: Optional[int] = None,
+        bin_sync_every: Optional[int] = None,
+        bin_resort_drift: Optional[float] = None,
     ):
         # telemetry registry: every driver-visible control-flow event
         # (reconfigure/rollback/replay/retrace) and step timing reports
@@ -317,7 +343,10 @@ class Simulation:
             k: v for k, v in (("block", block),
                               ("list_skin_rel", list_skin_rel),
                               ("m2p_cap_margin", m2p_cap_margin),
-                              ("check_every", check_every))
+                              ("check_every", check_every),
+                              ("dt_bins", dt_bins),
+                              ("bin_sync_every", bin_sync_every),
+                              ("bin_resort_drift", bin_resort_drift))
             if v is not None
         }
         from sphexa_tpu.tuning.table import resolve_knobs
@@ -336,6 +365,40 @@ class Simulation:
         list_skin_rel = _knob("list_skin_rel", 0.2)
         m2p_cap_margin = _knob("m2p_cap_margin", 1.3)
         check_every = _knob("check_every", 1)
+        # hierarchical block time steps (sph/blockdt.py): dt_bins=None is
+        # today's global-dt path, bitwise unchanged; dt_bins=1 runs the
+        # blockdt machinery pinned bitwise-equal to it (tests/
+        # test_blockdt.py); dt_bins>1 activates per-particle Δt bins
+        dt_bins = _knob("dt_bins", None)
+        bin_sync_every = int(_knob("bin_sync_every", 1))
+        bin_resort_drift = float(_knob("bin_resort_drift", 0.0))
+        self._blockdt = dt_bins is not None
+        if self._blockdt:
+            if prop not in _PROPAGATORS_BLOCKDT:
+                raise ValueError(
+                    f"dt_bins (hierarchical block time steps) supports "
+                    f"the std/ve propagators, not prop={prop!r}"
+                )
+            dt_bins = int(dt_bins)
+            if dt_bins < 1:
+                raise ValueError(f"dt_bins must be >= 1, got {dt_bins}")
+            if bin_sync_every < 1:
+                raise ValueError(
+                    f"bin_sync_every must be >= 1, got {bin_sync_every}")
+            if bin_resort_drift < 0.0:
+                raise ValueError(
+                    f"bin_resort_drift must be >= 0, got {bin_resort_drift}")
+        self.dt_bins = dt_bins
+        self.bin_sync_every = bin_sync_every
+        self.bin_resort_drift = bin_resort_drift
+        # host-side block-dt accounting across fetch boundaries — the
+        # chip-free complexity proxy (docs/NEXT.md): particle updates
+        # actually performed vs what global-dt would have performed over
+        # the same substeps (each substep advances dt_min either way)
+        self.bdt_updates = 0
+        self.bdt_updates_full = 0
+        self.bdt_resorts = 0
+        self.bdt_keeps = 0
         # reconfigure-cost knobs the configure paths consume each time
         self._nbr_knobs = {k: tuned_knobs[k]
                            for k in ("cell_target", "run_cap", "gap",
@@ -461,6 +524,12 @@ class Simulation:
                 lambda a: jnp.copy(a) if hasattr(a, "ndim") else a,
                 self.state,
             )
+        # block-dt carry: per-particle bins + cycle scalars, built AFTER
+        # sharding so the (n,) leaves come from the placed state; never
+        # donated (the blockdt donated twins consume the ParticleState
+        # only), so window rollback pins it by reference
+        self._bstate = (make_blockdt_state(self.state, dt_bins)
+                        if self._blockdt else None)
         if prop == "nbody" and const.g == 0.0:
             raise ValueError(
                 "prop='nbody' needs a gravitational constant: set SimConstants(g=...)"
@@ -555,11 +624,14 @@ class Simulation:
     # -- static config management ------------------------------------------
     @property
     def _lists_eligible(self) -> bool:
+        # blockdt steps run their own fold-key sort prologue and have no
+        # frozen-order fast path — lists stay off under dt_bins
         return (
             self._want_lists
             and self._mesh is None
             and not self.gravity_on
             and self.prop_name != "nbody"
+            and not self._blockdt
         )
 
     def _configure(self, min_cap: int = 0, grav_margin: float = 1.5,
@@ -609,6 +681,8 @@ class Simulation:
             list_slot_margin=self._slot_margin,
             sizing_cache=sizing_cache,
             obs_spec=self._obs_spec,
+            dt_bins=self.dt_bins, bin_sync_every=self.bin_sync_every,
+            bin_resort_drift=self.bin_resort_drift,
             # table-resolved neighbor-engine knobs (cell_target/run_cap/
             # gap/group); absent keys fall to the factory defaults
             **self._nbr_knobs,
@@ -678,7 +752,7 @@ class Simulation:
         self._halo_info["bytes_per_step"] = (
             self._halo_info["shipped_rows"] * nf * 4)
         self._stepper = make_sharded_step(
-            self._mesh, self._cfg, _PROPAGATORS[self.prop_name],
+            self._mesh, self._cfg, self._step_fn(),
             halo_window=wmax, halo_cells=hcells, aux_cfg=aux_cfg,
         )
 
@@ -846,6 +920,16 @@ class Simulation:
             self._configure(reason="list-slot")
         raise RuntimeError("pair-list slot cap failed to converge")
 
+    def _step_fn(self, donated: bool = False):
+        """Active step builder for the configured mode: the blockdt twin
+        when ``dt_bins`` is set, the plain propagator otherwise."""
+        if self._blockdt:
+            table = (_PROPAGATORS_BLOCKDT_DONATED if donated
+                     else _PROPAGATORS_BLOCKDT)
+        else:
+            table = _PROPAGATORS_DONATED if donated else _PROPAGATORS
+        return table[self.prop_name]
+
     # -- main loop ----------------------------------------------------------
     def _drain(self, out):
         """CPU-mesh collective serialization: a program's scalar outputs
@@ -867,7 +951,7 @@ class Simulation:
 
         key = (self.prop_name, self._cfg, self.turb_cfg, self.cooling_cfg)
         if self._checked_cache.get("key") != key:
-            step_fn = _PROPAGATORS[self.prop_name]
+            step_fn = self._step_fn()
             cfg = self._cfg
             if self.prop_name == "turb-ve":
                 aux_cfg = self.turb_cfg
@@ -877,6 +961,9 @@ class Simulation:
                 aux_cfg = self.cooling_cfg
                 base = lambda s, b, g, aux: step_fn(s, b, cfg, g, aux,
                                                     aux_cfg)
+            elif self._blockdt:
+                # the BlockDtState rides the aux slot; 4-tuple return
+                base = lambda s, b, g, aux: step_fn(s, b, cfg, g, aux)
             else:
                 base = lambda s, b, g, aux: step_fn(s, b, cfg, g)
             errors = checkify.float_checks | checkify.index_checks
@@ -894,17 +981,22 @@ class Simulation:
             aux = self.turb_state
         elif self.prop_name == "std-cooling":
             aux = self.chem
+        elif self._blockdt:
+            aux = self._bstate
         self._check_err, out = self._checkified_step()(
             self.state, self.box, self._gtree, aux
         )
         if self.prop_name == "turb-ve":
             new_state, new_box, diagnostics, new_turb = out
-            return new_state, new_box, diagnostics, new_turb, None
+            return new_state, new_box, diagnostics, new_turb, None, None
         if self.prop_name == "std-cooling":
             new_state, new_box, diagnostics, new_chem = out
-            return new_state, new_box, diagnostics, None, new_chem
+            return new_state, new_box, diagnostics, None, new_chem, None
+        if self._blockdt:
+            new_state, new_box, diagnostics, new_bst = out
+            return new_state, new_box, diagnostics, None, None, new_bst
         new_state, new_box, diagnostics = out
-        return new_state, new_box, diagnostics, None, None
+        return new_state, new_box, diagnostics, None, None, None
 
     def _compiled_cache_size(self) -> int:
         """Total jit-cache entries behind the ACTIVE launch path — the
@@ -916,8 +1008,7 @@ class Simulation:
         elif self._mesh is not None:
             fns = [getattr(self, "_stepper", None)]
         else:
-            fns = [_PROPAGATORS[self.prop_name],
-                   _PROPAGATORS_DONATED[self.prop_name]]
+            fns = [self._step_fn(), self._step_fn(donated=True)]
         total = 0
         for f in fns:
             size = getattr(f, "_cache_size", None)
@@ -998,18 +1089,25 @@ class Simulation:
                         self.state, self.box, self._gtree, self.turb_state
                     )
                 )
-                return new_state, new_box, diagnostics, new_turb, None
+                return new_state, new_box, diagnostics, new_turb, None, None
             if self.prop_name == "std-cooling":
                 new_state, new_box, diagnostics, new_chem = self._drain(
                     self._stepper(
                         self.state, self.box, self._gtree, self.chem
                     )
                 )
-                return new_state, new_box, diagnostics, None, new_chem
+                return new_state, new_box, diagnostics, None, new_chem, None
+            if self._blockdt:
+                new_state, new_box, diagnostics, new_bst = self._drain(
+                    self._stepper(
+                        self.state, self.box, self._gtree, self._bstate
+                    )
+                )
+                return new_state, new_box, diagnostics, None, None, new_bst
             new_state, new_box, diagnostics = self._drain(
                 self._stepper(self.state, self.box, self._gtree)
             )
-            return new_state, new_box, diagnostics, None, None
+            return new_state, new_box, diagnostics, None, None, None
         donate_now = donate_ok and self._donate_active
         if donate_now:
             # freshly-built states alias leaves (build_state shares one
@@ -1018,9 +1116,8 @@ class Simulation:
             # duplicates once (step outputs are always distinct, so this
             # only ever pays on the first donated launch of a state)
             self.state = _dealias_leaves(self.state)
-        step_fn = (_PROPAGATORS_DONATED[self.prop_name] if donate_now
-                   else _PROPAGATORS[self.prop_name])
-        new_turb, new_chem = None, None
+        step_fn = self._step_fn(donated=donate_now)
+        new_turb, new_chem, new_bst = None, None, None
         kw = {}
         if self._use_lists:
             if self._lists is None:
@@ -1036,32 +1133,39 @@ class Simulation:
                 self.state, self.box, self._cfg, self._gtree,
                 self.chem, self.cooling_cfg, **kw,
             )
+        elif self._blockdt:
+            new_state, new_box, diagnostics, new_bst = step_fn(
+                self.state, self.box, self._cfg, self._gtree, self._bstate
+            )
         else:
             new_state, new_box, diagnostics = step_fn(
                 self.state, self.box, self._cfg, self._gtree, **kw
             )
-        return new_state, new_box, diagnostics, new_turb, new_chem
+        return new_state, new_box, diagnostics, new_turb, new_chem, new_bst
 
     def _apply(self, out):
-        new_state, new_box, _, new_turb, new_chem = out
+        new_state, new_box, _, new_turb, new_chem, new_bst = out
         self.state = new_state
         self.box = new_box
         if new_turb is not None:
             self.turb_state = new_turb
         if new_chem is not None:
             self.chem = new_chem
+        if new_bst is not None:
+            self._bstate = new_bst
 
     @staticmethod
     def _scalar_view(diagnostics) -> Dict:
         """Scalars + the tiny (P,) per-shard telemetry arrays
-        (SHARD_DIAG_KEYS) — everything the flush boundary fetches in one
-        batch. Per-particle arrays (keep_fields/keep_accels) stay on
-        device."""
-        from sphexa_tpu.propagator import SHARD_DIAG_KEYS
+        (SHARD_DIAG_KEYS) and (B,) bin populations (BLOCKDT_DIAG_KEYS) —
+        everything the flush boundary fetches in one batch. Per-particle
+        arrays (keep_fields/keep_accels) stay on device."""
+        from sphexa_tpu.propagator import BLOCKDT_DIAG_KEYS, SHARD_DIAG_KEYS
 
         return {
             k: v for k, v in diagnostics.items()
             if getattr(v, "ndim", 0) == 0 or k in SHARD_DIAG_KEYS
+            or k in BLOCKDT_DIAG_KEYS
         }
 
     @classmethod
@@ -1267,6 +1371,38 @@ class Simulation:
                       nonfinite=sum(step_bad.values()), fields=step_bad,
                       hint="re-run with --debug-checks to localize")
 
+    def _emit_blockdt(self, fetched, its) -> None:
+        """Schema-v6 block-timestep telemetry at the fetch boundary: one
+        ``dt_bins`` event per checked step / clean window, built from the
+        already-FETCHED per-substep bdt_* diagnostics — host arithmetic
+        only, the deferred-window zero-sync contract is untouched.  The
+        updates/updates_full counters double as the chip-free complexity
+        proxy (docs/NEXT.md): every substep advances sim-time by dt_min
+        under BOTH schemes, so the global-dt cost of the same span is
+        exactly n updates per substep."""
+        steps = [(it, d) for it, d in zip(its, fetched)
+                 if "bdt_active" in d]
+        if not steps:
+            return
+        ds = [d for _, d in steps]
+        n = self.state.n
+        updates = sum(int(d["bdt_active"]) for d in ds)
+        full = n * len(ds)
+        resorts = sum(int(d["bdt_resort"]) for d in ds)
+        self.bdt_updates += updates
+        self.bdt_updates_full += full
+        self.bdt_resorts += resorts
+        self.bdt_keeps += len(ds) - resorts
+        self.telemetry.event(
+            "dt_bins", it=steps[-1][0], steps=len(ds),
+            pop=[int(v) for v in np.asarray(ds[-1]["bdt_pop"])],
+            updates=updates, updates_full=full,
+            saved=round(1.0 - updates / full, 6) if full else 0.0,
+            resorts=resorts, keeps=len(ds) - resorts,
+            drift_max=max(int(d["bdt_drift"]) for d in ds),
+            work=sum(float(d["bdt_work"]) for d in ds),
+        )
+
     @staticmethod
     def _lists_fresh(diagnostics) -> bool:
         """False when the step ran on EXPIRED lists (drift/growth ate
@@ -1351,6 +1487,7 @@ class Simulation:
         )
         self._emit_distributed(diagnostics, steps=1)
         self._emit_science([diagnostics], [self.iteration])
+        self._emit_blockdt([diagnostics], [self.iteration])
         self._emit_memory("post-compile")
         if self.debug_checks:
             # first triggered checkify predicate of THIS step ("" = all
@@ -1390,8 +1527,9 @@ class Simulation:
             pin = self.state
             if self._donate_active:
                 pin = jax.tree.map(jnp.copy, self.state)
+            # _bstate is never donated, so the pin is a reference
             self._window_prior = (pin, self.box, self.turb_state,
-                                  self.chem, self.iteration)
+                                  self.chem, self.iteration, self._bstate)
         out = self._launch(donate_ok=True)
         self._apply(out)
         self.iteration += 1
@@ -1436,11 +1574,10 @@ class Simulation:
             # science ledger rides it too: one physics/numerics event +
             # a constants row per step of the window (every step keeps
             # its row even under --check-every N)
-            self._emit_science(
-                fetched,
-                list(range(self.iteration - len(pending) + 1,
-                           self.iteration + 1)),
-            )
+            win_its = list(range(self.iteration - len(pending) + 1,
+                                 self.iteration + 1))
+            self._emit_science(fetched, win_its)
+            self._emit_blockdt(fetched, win_its)
             self._emit_memory("post-compile")
             self._emit_memory("flush")
             diagnostics = {**pending[-1], **fetched[-1]}
@@ -1470,7 +1607,7 @@ class Simulation:
             reason="list-expiry" if expiry_only else "overflow",
         )
         (self.state, self.box, self.turb_state, self.chem,
-         self.iteration) = prior
+         self.iteration, self._bstate) = prior
         if expiry_only:
             # expiry only: fresh lists on the rolled-back state suffice
             self._rebuild_lists()

@@ -1,0 +1,180 @@
+"""Hierarchical block time steps: per-particle power-of-two Δt bins.
+
+The reference lineage's biggest untouched algorithmic lever (Bonsai's
+block steps, Bédorf et al. 2014 §3.4; PAPERS.md): instead of advancing
+every particle at the global minimum dt, each particle is assigned a bin
+``k`` and kicked with ``dt_min * 2**k`` every ``2**k``-th substep.  On
+deep-dynamic-range workloads (Sedov's cold quiet ambient around a hot
+core, Evrard collapse, disks) almost the whole box sits in deep bins and
+the particle-updates per unit sim-time drop by the bin-occupancy factor
+— the complexity proxy the schema-v6 ``dt_bins`` telemetry event records
+(no chip this round; docs/NEXT.md round-12 protocol).
+
+Scheme (the classic synchronized block layout):
+
+- ``B = dt_bins`` bins, cycle length ``C = 2**(B-1)`` substeps, each
+  substep advancing ``ttot`` by the cycle's ``dt_min``;
+- bin ``k`` is due at substep ``s`` iff ``(s+1) % 2**k == 0`` — bin 0
+  every substep, the deepest bin once per cycle, and EVERY bin is due at
+  ``s = C-1``, so the cycle boundary is a full synchronization point;
+- at ``s = 0`` (right after the all-due substep) ``dt_min`` is
+  recomputed with the SAME ``compute_timestep`` expression as the global
+  path, and bins are reassigned from the elementwise limiter candidates
+  every ``bin_sync_every``-th cycle;
+- inactive particles drift ``x += v * dt_min`` each substep (they are
+  force SOURCES at current positions); when a particle comes due, the
+  accumulated drift is rebased away and one full Press update of size
+  ``dt_min * 2**k`` runs from its last-kick position
+  (propagator._integrate_and_finish_blockdt).
+
+``dt_bins = 1`` degenerates to C = 1, every substep a sync, every
+particle due, ``dt_eff = dt_min * 2**0`` — bitwise-identical to the
+global-dt step (pinned in tests/test_blockdt.py).
+
+The bin candidates are ELEMENTWISE mirrors of the timestep.py limiters
+(which are global min-reductions): Courant ``k_cour*h/c`` and, under
+gravity, ``eta_acc*sqrt(eps/|a|)``.  The VE rho limiter (``k_rho/|divv|``)
+is not mirrored — plumbing divv out of the sharded force stage would
+change the existing shard_map signature (and its lowering, which
+dt_bins=None pins byte-identical); compressing regions have small
+``h/c`` anyway, and the global ``dt_min`` keeps the rho bound.  The
+Courant mirror uses the particle's own sound speed, not the pairwise
+max signal speed the kernels min-reduce — the standard local estimate in
+block-step codes; the deepest admissible bin is a heuristic, safety
+comes from ``dt_min`` itself.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from sphexa_tpu.dtypes import HYDRO_DTYPE, INDEX_DTYPE, KEY_BITS, KEY_DTYPE
+from sphexa_tpu.gravity.pallas_compact import IDX_BITS, compact_class_lists
+from sphexa_tpu.util.phases import named_phase
+
+#: secondary-key bits available below the 3*KEY_BITS spatial key in one
+#: uint32 sort key (keys.py packs 30 bits -> 2 spare)
+FOLD_BITS = 32 - 3 * KEY_BITS
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BlockDtState:
+    """Per-particle bin bookkeeping + cycle scalars, carried by the
+    Simulation alongside the ParticleState and permuted through the
+    step's SFC sort via the aux channel (scalars pass through untouched,
+    like ParticleState's integrator scalars)."""
+
+    bins: jax.Array      # (n,) int32  power-of-two Δt bin per particle
+    dt_prev: jax.Array   # (n,) f32    dt of each particle's previous kick
+    substep: jax.Array   # ()  int32   position within the current cycle
+    cycle: jax.Array     # ()  int32   completed-cycle counter
+    dt_min: jax.Array    # ()  f32     bin-0 dt of the current cycle
+
+
+def make_blockdt_state(state, nbins: int) -> BlockDtState:
+    """Fresh carry: everyone in bin 0 (the first sync substep re-bins),
+    dt_prev = the state's min_dt so the first Press update sees the same
+    dt_m1 the global path would."""
+    del nbins  # bins start at 0 regardless of depth
+    n = state.n
+    return BlockDtState(
+        bins=jnp.zeros(n, INDEX_DTYPE),
+        dt_prev=jnp.full((n,), 1.0, HYDRO_DTYPE) * state.min_dt,
+        substep=jnp.zeros((), INDEX_DTYPE),
+        cycle=jnp.zeros((), INDEX_DTYPE),
+        dt_min=jnp.asarray(state.min_dt, HYDRO_DTYPE),
+    )
+
+
+def cycle_length(nbins: int) -> int:
+    """Substeps per cycle: the deepest bin steps once per cycle."""
+    return 1 << (nbins - 1)
+
+
+@named_phase("dt-bins")
+def particle_dt_candidates(h, c, const, ax=None, ay=None, az=None):
+    """Elementwise dt candidates per particle (see module docstring):
+    Courant ``k_cour*h/c`` plus, when accelerations are given, the
+    acceleration limiter ``eta_acc*sqrt(eps/|a|)`` (the per-particle
+    mirror of timestep.acceleration_timestep's global max|a|).  |a| = 0
+    gives inf — harmless, the bin clip saturates."""
+    dt = const.k_cour * h / c
+    if ax is not None:
+        acc = jnp.sqrt(ax * ax + ay * ay + az * az)
+        dt = jnp.minimum(dt, const.eta_acc * jnp.sqrt(const.eps / acc))
+    return dt
+
+
+@named_phase("dt-bins")
+def assign_bins(dt_part, dt_min, nbins: int):
+    """Bin index ``k = clip(floor(log2(dt_i / dt_min)), 0, nbins-1)`` —
+    the deepest power-of-two multiple of dt_min the particle's own
+    candidate admits.  The clip runs in f32 BEFORE the int cast so inf
+    candidates (zero acceleration) saturate instead of overflowing."""
+    ratio = jnp.maximum(dt_part / dt_min, 1.0)
+    k = jnp.clip(jnp.floor(jnp.log2(ratio)), 0.0, float(nbins - 1))
+    return k.astype(INDEX_DTYPE)
+
+
+def due_mask(bins, substep):
+    """Bin k is due every 2**k-th substep, all bins aligned at the cycle
+    end: due iff ``(substep + 1) % 2**k == 0``.  Bitmask form (the period
+    is a power of two) so it is one shift + and + compare."""
+    period_mask = jnp.left_shift(jnp.int32(1), bins) - 1
+    return jnp.bitwise_and(substep + 1, period_mask) == 0
+
+
+@named_phase("dt-bins")
+def bin_populations(bins, nbins: int):
+    """(nbins,) occupancy histogram — one-hot sum, not scatter-add (TPU
+    scatters serialize; nbins is tiny so the (n, nbins) one-hot is
+    cheap).  This is the complexity-proxy source: updates per cycle =
+    sum_k pop[k] * C / 2**k."""
+    hot = bins[:, None] == jnp.arange(nbins, dtype=bins.dtype)[None, :]
+    return jnp.sum(hot, axis=0, dtype=INDEX_DTYPE)
+
+
+def fold_bin_key(keys, bins):
+    """Secondary-key fold: spatial SFC key in the high bits, (saturated)
+    bin index in the low FOLD_BITS.  One uint32 argsort then yields a
+    spatially sorted order with equal-key particles grouped by bin.
+
+    Deviation from the ISSUE's bin-major prefix wording, by design: a
+    global bin prefix would break the SFC cell-range neighbor engines,
+    which require the permuted state to be spatially sorted — the GLOBAL
+    contiguous active set is realized by the compaction index lists
+    (compact_active) instead.  Bins beyond 2**FOLD_BITS - 1 saturate in
+    the FOLD ONLY (grouping granularity; the bins array keeps full
+    depth), which also keeps the fold inside uint32 at any dt_bins.
+    """
+    b = jnp.minimum(bins, (1 << FOLD_BITS) - 1).astype(KEY_DTYPE)
+    return jnp.bitwise_or(jnp.left_shift(keys, FOLD_BITS), b)
+
+
+@named_phase("dt-bins")
+def compact_active(due, use_kernel: bool = False, interpret: bool = False):
+    """Active-index list + count from the due mask.
+
+    ``use_kernel``: route through the PR 1 bitmask+popcount-rank Mosaic
+    compaction (gravity/pallas_compact.py) — one (1, n) row, class 0 =
+    due, class 1 = dropped; requires n < 2**IDX_BITS.  Otherwise (XLA
+    fallback off-TPU and on sharded runs, where the argsort turns into
+    the GSPMD-planned global sort) a stable argsort of the class ints —
+    both paths return the active indices first, in candidate order.
+
+    Returns ``(idx (n,) i32, n_active () i32)``; idx entries beyond
+    n_active are inactive rows (argsort) or zero-padding (kernel) and
+    must be masked by the caller.
+    """
+    n = due.shape[0]
+    cls = jnp.where(due, 0, 1).astype(jnp.int32)
+    n_active = jnp.sum(due.astype(INDEX_DTYPE))
+    if use_kernel and n < (1 << IDX_BITS):
+        packed = jnp.bitwise_or(jnp.left_shift(cls, IDX_BITS),
+                                jnp.arange(n, dtype=jnp.int32))
+        lst0, n0, _, _ = compact_class_lists(packed[None, :], n, 1,
+                                             interpret=interpret)
+        return lst0[0], n0[0]
+    return jnp.argsort(cls).astype(INDEX_DTYPE), n_active

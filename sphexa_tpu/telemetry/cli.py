@@ -345,6 +345,7 @@ def summarize_science(run_dir: str) -> Dict:
     events, problems = load_events(run_dir)
     phys = _of_kind(events, "physics")
     nums = _of_kind(events, "numerics")
+    bins = _of_kind(events, "dt_bins")
 
     its = [int(x) for x in _concat_series(phys, "its")]
     series = {k: _concat_series(phys, k)
@@ -383,6 +384,23 @@ def summarize_science(run_dir: str) -> Dict:
                                   "h_max", "du_max", "nc_clip", "h_sat")
         })
 
+    # block-timestep view (schema v6 dt_bins events): the run-total
+    # particle-update counters ARE the chip-free complexity proxy, the
+    # last event's histogram shows where the bins settled
+    dt_bins_view = None
+    if bins:
+        updates = sum(int(e.get("updates", 0)) for e in bins)
+        full = sum(int(e.get("updates_full", 0)) for e in bins)
+        dt_bins_view = {
+            "events": len(bins),
+            "pop": bins[-1].get("pop"),
+            "updates": updates,
+            "updates_full": full,
+            "saved_factor": (full / updates) if updates else None,
+            "resorts": sum(int(e.get("resorts", 0)) for e in bins),
+            "keeps": sum(int(e.get("keeps", 0)) for e in bins),
+        }
+
     return {
         "run_dir": run_dir,
         "manifest": read_manifest(run_dir),
@@ -394,6 +412,7 @@ def summarize_science(run_dir: str) -> Dict:
         "limiter": dict(sorted(limiter.items())),
         "nonfinite": nonfinite,
         "extrema": extrema_rows,
+        "dt_bins": dt_bins_view,
         "drift_events": len(_of_kind(events, "drift")),
         "field_health_events": len(_of_kind(events, "field_health")),
         "crash": _crash_view(run_dir),
@@ -726,6 +745,22 @@ def render_science(s: Dict) -> str:
              for name, n in sorted(s["limiter"].items(),
                                    key=lambda kv: -kv[1])],
             headers=("limiter", "steps", "share")))
+    b = s.get("dt_bins")
+    if b:
+        pop = b.get("pop") or []
+        tot = sum(pop) or 1
+        lines.append("dt bins (hierarchical block time steps):")
+        lines.append(render_table(
+            [(f"2^{k} x dt_min", n, f"{n / tot:.1%}")
+             for k, n in enumerate(pop)],
+            headers=("bin", "particles", "share")))
+        saved = b.get("saved_factor")
+        lines.append(render_table([
+            ("particle updates", b["updates"]),
+            ("global-dt equivalent", b["updates_full"]),
+            ("updates saved", "-" if saved is None else f"{saved:.2f}x"),
+            ("resorts / keeps", f"{b['resorts']} / {b['keeps']}"),
+        ]))
     ext = [r for r in s.get("extrema", []) if r.get("it") is not None]
     if ext:
         lines.append("extrema timeline (per checked step / window):")

@@ -24,13 +24,14 @@ import numpy as np
 #: distributed kinds (exchange / shard_load / memory / imbalance), v3
 #: the physics-observability kinds (physics / numerics / drift /
 #: field_health), v4 the time-and-history kinds (phase_attr / crash),
-#: v5 the autotuning kinds (sweep / tuning); none changed the older
-#: kinds, so v5 readers accept v1-v4 files.
-SCHEMA_VERSION = 5
+#: v5 the autotuning kinds (sweep / tuning), v6 the block-timestep kind
+#: (dt_bins); none changed the older kinds, so v6 readers accept v1-v5
+#: files.
+SCHEMA_VERSION = 6
 
 #: event schema versions this reader understands (older versions only
 #: ever ADD kinds, so the per-kind field table below covers them all)
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 #: every event kind the schema admits, with its required payload fields
 #: (beyond the envelope ``v``/``seq``/``t``/``kind``). The CLI's --strict
@@ -95,6 +96,14 @@ EVENT_KINDS: Dict[str, tuple] = {
     # also emitted by gravity_tuning when N sits within 10% of its
     # step-function threshold (the near-cliff attribution note)
     "tuning": ("source",),
+    # -- v6: block-timestep kind (sph/blockdt.py) -------------------------
+    # per-window hierarchical block-dt record: ``pop`` = the (dt_bins,)
+    # bin-occupancy histogram at the window's last substep, ``updates``/
+    # ``updates_full`` = particle updates performed vs the global-dt cost
+    # of the same substeps (the chip-free complexity proxy, docs/NEXT.md),
+    # plus the drift-aware resort decision counters (resorts/keeps) and
+    # the worst observed key-drift inversion count (drift_max)
+    "dt_bins": ("it", "pop", "updates", "updates_full"),
 }
 
 #: first schema version each kind appeared in (an older-versioned event
@@ -103,8 +112,9 @@ _V2_ONLY = frozenset({"exchange", "shard_load", "memory", "imbalance"})
 _V3_ONLY = frozenset({"physics", "numerics", "drift", "field_health"})
 _V4_ONLY = frozenset({"phase_attr", "crash"})
 _V5_ONLY = frozenset({"sweep", "tuning"})
+_V6_ONLY = frozenset({"dt_bins"})
 KIND_SINCE: Dict[str, int] = {
-    k: 5 if k in _V5_ONLY else 4 if k in _V4_ONLY
+    k: 6 if k in _V6_ONLY else 5 if k in _V5_ONLY else 4 if k in _V4_ONLY
     else 3 if k in _V3_ONLY else 2 if k in _V2_ONLY else 1
     for k in EVENT_KINDS
 }

@@ -18,6 +18,7 @@ package lazily, inside the branch that needs it).
 """
 
 from sphexa_tpu.tuning.knobs import (
+    BLOCKDT_KNOBS,
     COST_RECONFIGURE,
     COST_STATIC,
     GRAVITY_KNOBS,
@@ -58,6 +59,7 @@ __all__ = [
     "KnobSpec", "KNOBS", "knob_names", "validate_registry",
     "COST_STATIC", "COST_RECONFIGURE",
     "GRAVITY_KNOBS", "NEIGHBOR_KNOBS", "SIMULATION_KNOBS",
+    "BLOCKDT_KNOBS",
     "ReplaySpec", "spec_from_manifest", "build_case", "measure_candidate",
     "static_cost_candidate",
     "domains_for", "run_sweep",

@@ -92,6 +92,24 @@ KNOBS: Dict[str, KnobSpec] = {
                  (1, 4, 8), COST_STATIC,
                  "deferred resort/verify window: steps launched between "
                  "batched diagnostic fetches (the resort cadence)"),
+        # -- hierarchical block time steps (sph/blockdt.py) ---------------
+        # NOTE: dt_bins changes the integration scheme, not just its
+        # cost — sweep it only under a conservation-drift budget (the
+        # replay driver's science gate), never on wall time alone
+        KnobSpec("dt_bins", "PropagatorConfig", "dt_bins",
+                 (2, 4, 8), COST_STATIC,
+                 "power-of-two per-particle dt bins (None/absent = the "
+                 "global-dt path; updates saved scale with occupancy of "
+                 "the deep bins)"),
+        KnobSpec("bin_sync_every", "PropagatorConfig", "bin_sync_every",
+                 (1, 2, 4), COST_STATIC,
+                 "cycles between bin reassignments at the sync substep "
+                 "(higher = fewer rebin passes, staler bins)"),
+        KnobSpec("bin_resort_drift", "PropagatorConfig",
+                 "bin_resort_drift", (0.0, 0.01, 0.05), COST_STATIC,
+                 "drift-aware resort threshold: keep the current order "
+                 "while folded-key inversions stay under this fraction "
+                 "of n (0 = resort whenever any inversion appears)"),
     )
 }
 
@@ -119,6 +137,9 @@ NEIGHBOR_KNOBS = ("block", "cell_target", "run_cap", "gap", "group",
                   "list_skin_rel")
 #: knobs resolved on the Simulation constructor itself
 SIMULATION_KNOBS = ("check_every",)
+#: block-timestep knobs (also Simulation-constructor-resolved; they land
+#: on PropagatorConfig through make_propagator_config)
+BLOCKDT_KNOBS = ("dt_bins", "bin_sync_every", "bin_resort_drift")
 
 
 def knob_names() -> Tuple[str, ...]:

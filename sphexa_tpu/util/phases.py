@@ -48,6 +48,7 @@ PHASES = (
     "cooling",          # radiative-cooling timestep + source integration
     "turbulence",       # OU stirring accelerations
     "timestep",         # dt candidate min-reduction + limiter attribution
+    "dt-bins",          # block-timestep bin assignment, active compaction
     "integrate",        # drift/kick, PBC wrap, smoothing-length nudge
     "ledger",           # in-graph conservation/numerics science ledger
     "shard-metrics",    # per-shard telemetry pack + gather

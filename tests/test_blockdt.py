@@ -1,0 +1,204 @@
+"""Hierarchical block time steps (sph/blockdt.py + the *_blockdt step
+builders): scheme unit tests, the dt_bins=1 bitwise pin against the
+global-dt path, the two-scale update-reduction proxy with its
+conservation budget, the dt_bins=None lowering guard, telemetry/resort
+counters, and (slow) sharded==single-device bin assignment at P=2."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sphexa_tpu.init import init_sedov
+from sphexa_tpu.observables import ObservableSpec
+from sphexa_tpu.simulation import Simulation, make_propagator_config
+from sphexa_tpu.sph import blockdt as bdt
+from sphexa_tpu.telemetry import MemorySink, Telemetry
+from sphexa_tpu.telemetry.registry import validate_event
+
+#: every integrator-visible ParticleState field the blockdt tail writes —
+#: the dt_bins=1 pin below asserts BITWISE equality on all of them
+_PINNED_FIELDS = (
+    "x", "y", "z", "x_m1", "y_m1", "z_m1",
+    "vx", "vy", "vz", "h", "temp", "du", "du_m1",
+    "ttot", "min_dt", "min_dt_m1",
+)
+
+
+class TestScheme:
+    """Pure-math unit tests of the bin scheme."""
+
+    def test_due_schedule(self):
+        B = 4
+        C = bdt.cycle_length(B)
+        assert C == 8
+        bins = jnp.arange(B, dtype=jnp.int32)
+        for s in range(C):
+            due = np.asarray(bdt.due_mask(bins, jnp.int32(s)))
+            expect = [(s + 1) % (1 << k) == 0 for k in range(B)]
+            assert due.tolist() == expect, f"substep {s}"
+        # bin 0 fires every substep; the cycle end synchronizes ALL bins
+        assert np.asarray(bdt.due_mask(bins, jnp.int32(C - 1))).all()
+
+    def test_assign_bins_clips_and_saturates(self):
+        dt_min = jnp.float32(1e-4)
+        cand = jnp.asarray([1e-4, 2.5e-4, 9e-4, 1e2, np.inf, 5e-5],
+                           jnp.float32)
+        k = np.asarray(bdt.assign_bins(cand, dt_min, 4))
+        # 1x -> 0; 2.5x -> 1; 9x -> 3; huge and inf saturate at nbins-1;
+        # below dt_min clamps to 0 (never a negative bin)
+        assert k.tolist() == [0, 1, 3, 3, 3, 0]
+
+    def test_fold_key_spatial_major_bin_minor(self):
+        keys = jnp.asarray([5, 5, 4, 6], dtype=jnp.uint32)
+        bins = jnp.asarray([3, 0, 9, 1], jnp.int32)  # 9 saturates in fold
+        folded = np.asarray(bdt.fold_bin_key(keys, bins))
+        order = np.argsort(folded, kind="stable")
+        # spatial key dominates; the equal-key pair is grouped by bin
+        assert order.tolist() == [2, 1, 0, 3]
+        # fold stays in uint32 and is invertible back to the spatial key
+        assert (folded >> bdt.FOLD_BITS == np.asarray(keys)).all()
+
+    def test_compact_active_kernel_matches_argsort(self):
+        rng = np.random.default_rng(0)
+        due = jnp.asarray(rng.random(512) < 0.3)
+        idx_x, n_x = bdt.compact_active(due, use_kernel=False)
+        idx_k, n_k = bdt.compact_active(due, use_kernel=True,
+                                        interpret=True)
+        n_ref = int(np.asarray(due).sum())
+        assert int(n_x) == int(n_k) == n_ref
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(idx_x)[:n_ref]),
+            np.sort(np.asarray(idx_k)[:n_ref]))
+        # both paths put ACTIVE rows first
+        assert np.asarray(due)[np.asarray(idx_k)[:n_ref]].all()
+        assert np.asarray(due)[np.asarray(idx_x)[:n_ref]].all()
+
+
+class TestBitwisePin:
+    """dt_bins=1 must reproduce the global-dt path to the bit, for every
+    step builder the blockdt mode touches (acceptance pin)."""
+
+    @pytest.mark.parametrize("prop", ["std", "ve"])
+    def test_dt_bins_1_matches_global(self, prop):
+        state, box, const = init_sedov(8)
+        ref = Simulation(state, box, const, prop=prop, block=512)
+        one = Simulation(state, box, const, prop=prop, block=512,
+                         dt_bins=1)
+        for _ in range(3):
+            ref.step()
+            one.step()
+        for f in _PINNED_FIELDS:
+            a, b = getattr(ref.state, f), getattr(one.state, f)
+            if a is None:
+                assert b is None, f
+                continue
+            assert np.array_equal(np.asarray(a), np.asarray(b)), f
+
+    def test_dt_bins_none_lowering_untouched(self):
+        # the opt-out guard: a default config must lower without ANY
+        # block-timestep scope — dt_bins=None leaves the global path's
+        # HLO byte-identical, which this scope scan pins cheaply
+        from sphexa_tpu import propagator as prop
+
+        state, box, const = init_sedov(6)
+        cfg = make_propagator_config(state, box, const, block=512)
+        assert cfg.dt_bins is None
+        txt = prop.step_hydro_std.lower(state, box, cfg, None).as_text()
+        assert "dt-bins" not in txt
+        assert "bdt_" not in txt
+
+
+class TestTwoScaleProxy:
+    """Sedov is the two-scale case: a hot injected core (small Courant
+    dt) inside a cold quiet ambient whose candidates are orders larger —
+    the ambient lands in the deep bins and the updates-saved factor is
+    the bin-occupancy complexity proxy recorded in docs/NEXT.md."""
+
+    def test_update_reduction_and_conservation(self):
+        state, box, const = init_sedov(8)
+        spec = ObservableSpec()
+        ref = Simulation(state, box, const, prop="std", block=512,
+                         obs_spec=spec)
+        blk = Simulation(state, box, const, prop="std", block=512,
+                         dt_bins=4, obs_spec=spec)
+        steps = 2 * bdt.cycle_length(4)
+        for _ in range(steps):
+            ref.step()
+            blk.step()
+        # the acceptance pin: >= 5x fewer particle-updates than the
+        # global-dt equivalent of the same substep span
+        assert blk.bdt_updates_full == steps * state.n
+        assert blk.bdt_updates > 0
+        factor = blk.bdt_updates_full / blk.bdt_updates
+        assert factor >= 5.0, f"updates-saved factor {factor:.2f} < 5"
+        # conservation stays inside the e2e drift budget on both paths
+        assert blk.energy_drift is not None
+        assert blk.energy_drift <= 1e-5
+        assert ref.energy_drift is not None and ref.energy_drift <= 1e-5
+
+
+class TestTelemetryAndResort:
+    def test_dt_bins_event_and_resort_counters(self):
+        sink = MemorySink()
+        state, box, const = init_sedov(8)
+        sim = Simulation(state, box, const, prop="ve", block=512,
+                         dt_bins=4, bin_resort_drift=0.01, check_every=4,
+                         telemetry=Telemetry(sinks=[sink]))
+        for _ in range(8):
+            sim.step()
+        sim.flush()
+        evs = sink.of_kind("dt_bins")
+        assert evs, "no dt_bins event at the flush boundary"
+        for e in evs:
+            assert e["v"] == 6
+            assert validate_event(e) == []
+        last = evs[-1]
+        assert len(last["pop"]) == 4
+        assert sum(last["pop"]) == state.n
+        assert 0 < last["updates"] <= last["updates_full"]
+        # drift-aware resort: the decision counters cover the window
+        assert sim.bdt_resorts + sim.bdt_keeps == 8
+        assert sim.bdt_keeps >= 1, "threshold 0.01 should keep sometimes"
+
+    def test_tuned_dict_resolves_blockdt_knobs(self):
+        state, box, const = init_sedov(6)
+        sim = Simulation(state, box, const, prop="std", block=512,
+                         tuned={"dt_bins": 2, "bin_sync_every": 2})
+        assert sim.dt_bins == 2 and sim.bin_sync_every == 2
+        sim.step()  # engages the blockdt step builder
+        assert sim.bdt_updates_full == state.n
+
+    def test_rejects_unsupported_propagator(self):
+        state, box, const = init_sedov(6)
+        with pytest.raises(ValueError, match="dt_bins"):
+            Simulation(state, box, const, prop="nbody", dt_bins=2)
+
+    def test_rejects_bad_knob_values(self):
+        state, box, const = init_sedov(6)
+        with pytest.raises(ValueError):
+            Simulation(state, box, const, prop="std", dt_bins=0)
+        with pytest.raises(ValueError):
+            Simulation(state, box, const, prop="std", dt_bins=2,
+                       bin_sync_every=0)
+
+
+@pytest.mark.slow
+class TestShardedBins:
+    """P=2 sharded run must assign the SAME bins as single-device (the
+    blockdt math runs outside shard_map, GSPMD-partitioned)."""
+
+    def test_bin_assignment_matches_single_device(self):
+        state, box, const = init_sedov(8)
+        single = Simulation(state, box, const, prop="std", block=512,
+                            backend="pallas", dt_bins=4)
+        shard = Simulation(state, box, const, prop="std", block=512,
+                           backend="pallas", num_devices=2, dt_bins=4)
+        for _ in range(2):
+            single.step()
+            shard.step()
+        np.testing.assert_array_equal(np.asarray(single._bstate.bins),
+                                      np.asarray(shard._bstate.bins))
+        assert int(shard._bstate.substep) == int(single._bstate.substep)
+        assert np.float32(shard._bstate.dt_min) == np.float32(
+            single._bstate.dt_min)

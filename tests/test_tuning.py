@@ -10,6 +10,7 @@ import pytest
 
 from sphexa_tpu.tuning import knobs as knobs_mod
 from sphexa_tpu.tuning.knobs import (
+    BLOCKDT_KNOBS,
     GRAVITY_KNOBS,
     KNOBS,
     NEIGHBOR_KNOBS,
@@ -75,7 +76,7 @@ class TestKnobRegistry:
 
     def test_groupings_cover_registry(self):
         grouped = set(GRAVITY_KNOBS) | set(NEIGHBOR_KNOBS) | set(
-            SIMULATION_KNOBS)
+            SIMULATION_KNOBS) | set(BLOCKDT_KNOBS)
         assert grouped == set(knob_names())
         # domains are non-empty and lead with the production default
         for spec in KNOBS.values():
@@ -311,7 +312,6 @@ class TestReplay:
 
 class TestSchemaV5:
     def test_v5_kinds_registered(self):
-        assert SCHEMA_VERSION == 5
         assert KIND_SINCE["sweep"] == 5
         assert KIND_SINCE["tuning"] == 5
 
@@ -338,9 +338,31 @@ class TestSchemaV5:
                 (1, "step", {"it": 0, "wall_s": 0.1}),
                 (2, "exchange", {"it": 0, "shipped_rows": 1, "rows": 1}),
                 (3, "physics", {"it": 0, "etot": 1.0}),
-                (4, "crash", {"reason": "test"})):
+                (4, "crash", {"reason": "test"}),
+                (5, "sweep", {"candidate": 0, "knobs": {},
+                              "status": "ok"})):
             e = {"v": v, "seq": 0, "t": 1.0, "kind": kind, **payload}
             assert validate_event(e) == [], (v, kind)
+
+
+class TestSchemaV6:
+    def test_v6_kind_registered(self):
+        assert SCHEMA_VERSION == 6
+        assert KIND_SINCE["dt_bins"] == 6
+
+    def test_v6_event_validates(self):
+        ok = {"v": 6, "seq": 0, "t": 1.0, "kind": "dt_bins", "it": 3,
+              "pop": [100, 50, 25, 337], "updates": 512,
+              "updates_full": 4096}
+        assert validate_event(ok) == []
+        assert any("missing field 'pop'" in p for p in validate_event(
+            {"v": 6, "seq": 0, "t": 1.0, "kind": "dt_bins", "it": 3,
+             "updates": 1, "updates_full": 1}))
+
+    def test_v6_kind_on_older_version_flagged(self):
+        bad = {"v": 5, "seq": 0, "t": 1.0, "kind": "dt_bins", "it": 0,
+               "pop": [1], "updates": 1, "updates_full": 1}
+        assert any("v6-only" in p for p in validate_event(bad))
 
 
 class TestCli:
