@@ -363,12 +363,29 @@ run_multichip_diff() {
     python -m sphexa_tpu.telemetry diff MULTICHIP_BASELINE.json \
         "$tmp/multichip.json" --threshold 0.05
     rc=$?
-    rm -rf "$tmp"
     if [ $rc -ne 0 ]; then
+        rm -rf "$tmp"
         echo "multi-chip comm volume regressed vs MULTICHIP_BASELINE.json"
         echo "(rc=$rc); if intentional, regenerate the baseline:"
         echo "  scripts/measure_multichip.py --quick --json  (wrap in the"
         echo "  {n_devices, rc, tail} driver shape, see the current file)"
+        exit $rc
+    fi
+    # the gravity comm diet must keep paying: MAC-need rows strictly
+    # below the retired full-slab exchange at the largest quick row
+    python - "$tmp/multichip.json" <<'EOF'
+import json, sys
+extra = json.load(open(sys.argv[1]))["extra"]
+saving = extra["s40_p8_grav_saving"]
+assert saving > 1.0, f"gravity MAC-need saving {saving} <= 1 (full slab)"
+print(f"gravity MAC-need saving vs full slab: {saving}x")
+EOF
+    rc=$?
+    rm -rf "$tmp"
+    if [ $rc -ne 0 ]; then
+        echo "gravity MAC-need sizing lost its saving vs the full-slab"
+        echo "exchange (rc=$rc): sizing.gravity_need_matrix or the serve"
+        echo "sizing regressed (docs/NEXT.md round 13)."
         exit $rc
     fi
 }

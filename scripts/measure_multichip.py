@@ -90,10 +90,31 @@ def measure(side, P, settle=True):
                                 box, cfg.nbr, P=P)
     win = (P - 1) * wmax
     rep = (P - 1) * S
+    # gravity near field (the MAC-sized sparse serve, r13): per-dest
+    # essential rows from the need matrix (what the Warren-Salmon LET
+    # would ship) vs the retired full-slab exchange's (P-1)*S, plus the
+    # per-distance cap fold the serve actually sizes its buffers from
+    from sphexa_tpu.gravity.tree import linkage_from_leaves
+    from sphexa_tpu.parallel.sizing import (
+        gravity_need_matrix,
+        leaf_array_from_device_keys,
+    )
+
+    leaf_tree = leaf_array_from_device_keys(keys, bucket_size=64)
+    gtree, meta = linkage_from_leaves(leaf_tree, curve="hilbert")
+    need = np.asarray(gravity_need_matrix(
+        state.x, state.y, state.z, state.m, keys, box, gtree, meta,
+        theta=0.5, P=P))
+    grav_need = float((need.sum() - np.trace(need)) / P)
+    j = np.arange(P)
+    grav_shipped = int(sum(int(need[(j + r) % P, j].max())
+                           for r in range(1, P)))
     return dict(n=n, S=S, wmax=wmax, ratio=wmax / S,
                 win_rows=win, rep_rows=rep, saving=rep / max(win, 1),
                 sparse=sparse_mean, sparse_frac=sparse_mean / S,
-                shipped=sum(hcells), shipped_frac=sum(hcells) / S)
+                shipped=sum(hcells), shipped_frac=sum(hcells) / S,
+                grav_need=grav_need, grav_shipped=grav_shipped,
+                grav_saving=rep / max(grav_need, 1.0))
 
 
 #: the cheap deterministic rows of --quick mode: lattice state (no
@@ -120,7 +141,8 @@ def main(argv=None):
     if not args.as_json:
         print(f"{'side':>5} {'n':>9} {'P':>3} {'S':>8} {'Wmax':>7} "
               f"{'Wmax/S':>7} {'rows/stage':>11} {'vs repl':>8} "
-              f"{'sparse':>8} {'sparse/S':>8} {'shipped':>8} {'ship/S':>7}")
+              f"{'sparse':>8} {'sparse/S':>8} {'shipped':>8} {'ship/S':>7} "
+              f"{'grav':>8} {'grav sv':>8}")
     for side, P in cases:
         try:
             r = measure(side, P, settle=not args.quick)
@@ -130,7 +152,8 @@ def main(argv=None):
                       f"{r['wmax']:>7} {r['ratio']:>7.3f} "
                       f"{r['win_rows']:>11} {r['saving']:>7.2f}x "
                       f"{r['sparse']:>8.0f} {r['sparse_frac']:>8.3f} "
-                      f"{r['shipped']:>8} {r['shipped_frac']:>7.2f}",
+                      f"{r['shipped']:>8} {r['shipped_frac']:>7.2f} "
+                      f"{r['grav_need']:>8.0f} {r['grav_saving']:>7.2f}x",
                       flush=True)
         except Exception as e:
             print(f"{side:>5} P={P} FAILED: {type(e).__name__}: {e}"[:140],
@@ -154,6 +177,9 @@ def main(argv=None):
         extra[f"{tag}_wmax_frac"] = round(r["ratio"], 4)
         extra[f"{tag}_saving"] = round(r["rep_rows"] / max(r["shipped"], 1),
                                        4)
+        extra[f"{tag}_grav_need_rows"] = round(r["grav_need"], 1)
+        extra[f"{tag}_grav_shipped_rows"] = int(r["grav_shipped"])
+        extra[f"{tag}_grav_saving"] = round(r["grav_saving"], 4)
     from sphexa_tpu.telemetry.manifest import build_manifest
 
     print(json.dumps({

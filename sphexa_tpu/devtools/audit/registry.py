@@ -410,6 +410,61 @@ def gravity_sharded():
     )
 
 
+@entrypoint("gravity_sharded_windowed", mesh_axes=("p",))
+def gravity_sharded_windowed():
+    """The MAC-sized sparse gravity near field: gravity_sharded's
+    program with per-distance row caps from sizing.device_gravity_halo
+    bound into the serve (exchange.serve_sparse riding the stage). Sized
+    at a node count and opening angle where the MAC genuinely prunes
+    (evrard side 20, theta 0.8 — at ``--mesh 4`` the sized volume sits
+    strictly below the full-slab baseline; docs/NEXT.md round 13), so
+    JXA203 records the gravity comm diet next to the full-slab entry's
+    number and JXA201 proves the longer chained collective schedule."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sphexa_tpu import native
+    from sphexa_tpu import propagator as prop
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.parallel import make_mesh
+    from sphexa_tpu.parallel.sizing import device_gravity_halo
+    from sphexa_tpu.simulation import Simulation
+
+    P, _ = _mesh_size_and_side()
+    state, box, const = make_initializer("evrard")(20)
+    n16 = (state.n // 16) * 16
+    state = jax.tree.map(
+        lambda a: a[:n16] if getattr(a, "ndim", 0) == 1 else a, state)
+    sim = Simulation(state, box, const, prop="nbody", theta=0.8)
+    s = sim.state
+    keys = native.compute_keys(
+        np.asarray(s.x), np.asarray(s.y), np.asarray(s.z),
+        np.asarray(sim.box.lo), np.asarray(sim.box.lengths), sim.curve,
+    )
+    order = native.argsort_keys(keys)
+    skeys = jnp.asarray(keys[order])
+    xs, ys, zs, ms, hs = (
+        jnp.asarray(np.asarray(f)[order])
+        for f in (s.x, s.y, s.z, s.m, s.h)
+    )
+    sstate = dataclasses.replace(s, x=xs, y=ys, z=zs, m=ms, h=hs)
+    cells = device_gravity_halo(
+        xs, ys, zs, ms, skeys, sim.box, sim._gtree, sim._cfg.grav_meta,
+        theta=sim.theta, P=P,
+    )
+    cfg_sh = dataclasses.replace(sim._cfg, mesh=make_mesh(P),
+                                 shard_axis="p", grav_cells=cells)
+    # 5 served fields (x/y/z/m/h) x f32; the replicated multipole psum
+    # and the all_gathered telemetry scalars ride the headroom
+    return EntryCase(
+        fn=lambda st, bb, k, gt: prop._gravity_sharded_stage(
+            st, bb, cfg_sh, gt, k),
+        args=(sstate, sim.box, skeys, sim._gtree),
+        exchange_budget_bytes=sum(cells) * 5 * 4 + _EXCHANGE_HEADROOM,
+    )
+
+
 # ---------------------------------------------------------------------------
 # sharded hydro step: the exact campaign entry — make_sharded_step's
 # propagator config (windowed/sparse halo sizing included) traced over

@@ -206,13 +206,15 @@ def compute_gravity_ewald(
     static jit region), matching computeGravityEwald's use of
     computeGravity(..., numReplicaShells).
 
-    ``shard``: (axis, P, Wmax) when running INSIDE shard_map on a local
+    ``shard``: (axis, P, win) when running INSIDE shard_map on a local
     slab (same contract as compute_gravity): the upsweep is the psum
     leaf-payload allreduce, each replica-shell near field rides the
-    windowed halo exchange (full-slab windows — shifted targets reach
-    wrap-around leaves anywhere in the box), and the per-particle
-    real/k-space corrections are row-local (the root expansion is
-    replicated by the psum). egrav and diagnostics return per-shard.
+    halo exchange (windowed for an int ``win``; MAC-sized sparse for a
+    per-distance cap tuple — the sizing unions the opened set over the
+    replica shifts, so wrap-around leaves any shifted target reaches
+    are covered), and the per-particle real/k-space corrections are
+    row-local (the root expansion is replicated by the psum). egrav and
+    diagnostics return per-shard.
     """
     L = box.lengths[0]
     n = x.shape[0]
@@ -264,6 +266,13 @@ def compute_gravity_ewald(
         "let_max": jnp.int32(0),
         "compact_width": jnp.int32(0),
     }
+    if shard is not None and isinstance(shard[2], tuple):
+        # sparse MAC-window mode: carry the per-shell exchange telemetry
+        # through the scan (max fold — the worst shell sizes the caps);
+        # keys absent from diag0 are dropped by the fold above, so these
+        # exist exactly when compute_gravity emits them
+        diag0["halo_rows"] = jnp.int32(0)
+        diag0["halo_occ"] = jnp.float32(0)
     (ax, ay, az, phi, diag), _ = jax.lax.scan(
         body, (zeros, zeros, zeros, zeros, diag0), (shifts, is_base)
     )

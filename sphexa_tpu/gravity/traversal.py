@@ -593,11 +593,15 @@ def compute_gravity(
     particle does interact with its own periodic image. Both are traced so
     the Ewald replica loop compiles this function once.
     ``mp_cache``: optional precomputed compute_multipoles result.
-    ``shard``: (axis, P, Wmax) when running INSIDE shard_map on a local
+    ``shard``: (axis, P, win) when running INSIDE shard_map on a local
     slab — x/y/z/... are then the slab, mp_cache must come from
     compute_multipoles_sharded (global edges), and the near field
-    fetches remote leaf rows through the windowed halo exchange
-    (parallel/exchange.py) instead of indexing a global array. egrav and
+    fetches remote leaf rows through the halo exchange
+    (parallel/exchange.py) instead of indexing a global array. ``win``
+    an int is the windowed exchange's Wmax (full-slab fallback at
+    win == S); a (P-1,)-tuple of ints is the MAC-sized sparse exchange's
+    per-distance row caps (sizing.device_gravity_halo), which also adds
+    ``halo_rows``/``halo_occ`` to the diagnostics. egrav and
     diagnostics are returned per-shard (the caller psums/pmaxes).
     """
     if shard is not None and not cfg.use_pallas:
@@ -1039,6 +1043,7 @@ def compute_gravity(
 
         out = jax.lax.map(one_chunk, (idx, bnum))
     escaped = jnp.asarray(False)
+    grav_halo_metrics = None
     if cfg.use_pallas:
         ax, ay, az, phi, m2p_n, p2p_n, p2p_starts, p2p_lens = out
         starts2 = p2p_starts.reshape(-1, cfg.p2p_cap)
@@ -1046,14 +1051,14 @@ def compute_gravity(
         jd = None
         if shard is not None:
             # near-field halos: leaf row ranges are GLOBAL rows; fetch
-            # the remote ones through per-peer windows (the same
-            # exchange the SPH stages ride; runs escaping their window
+            # the remote ones through the halo exchange (the same
+            # machinery the SPH stages ride; runs escaping their cap
             # flip the p2p sentinel so the driver re-sizes). The caller
-            # clamps Wmax <= slab rows (see _gravity_sharded_stage).
+            # clamps the window/caps <= slab rows (_gravity_sharded_stage).
             from sphexa_tpu.parallel import exchange as ex
             from sphexa_tpu.sph.pallas_pairs import GroupRanges
 
-            axis, P_, Wmax = shard
+            axis, P_, win = shard
             kk = jax.lax.axis_index(axis)
             zf = jnp.zeros_like(starts2, dtype=jnp.float32)
             pr = GroupRanges(
@@ -1063,11 +1068,30 @@ def compute_gravity(
                 occupancy=jnp.int32(0),
                 boxl=jnp.full((3,), 1e30, jnp.float32),
             )
-            lranges, bounds, escaped = ex.localize_ranges(
-                pr, n, P_, Wmax, kk, axis
-            )
-            halo = ex.serve_windows((x, y, z, m, h), bounds, n, Wmax,
-                                    P_, kk, axis)
+            if isinstance(win, tuple):
+                # MAC-sized sparse near field: ``edges`` (the sharded
+                # upsweep's global leaf row boundaries) IS a cell table
+                # in the exchange.py sense, so the cell-granular serve
+                # ships only the rows of leaves this slab's essential
+                # set opens — sized by sizing.device_gravity_halo, with
+                # full slabs (caps == S) as the retry ceiling
+                lranges, covered_all, escaped, covered = (
+                    ex.localize_ranges_sparse(pr, edges, n, P_, win, kk,
+                                              axis)
+                )
+                halo, _ = ex.serve_sparse(
+                    (x, y, z, m, h), covered_all, edges, n, win, P_, kk,
+                    axis, token=covered_all,
+                )
+                grav_halo_metrics = ex.exchange_metrics_sparse(
+                    covered, edges, n, win, P_, kk
+                )
+            else:
+                lranges, bounds, escaped = ex.localize_ranges(
+                    pr, n, P_, win, kk, axis
+                )
+                halo = ex.serve_windows((x, y, z, m, h), bounds, n, win,
+                                        P_, kk, axis)
             jd = tuple(
                 jnp.concatenate([o, a])
                 for o, a in zip((x, y, z, m, h), halo)
@@ -1152,6 +1176,13 @@ def compute_gravity(
             / jnp.float32(evals)
         ),
     }
+    if grav_halo_metrics is not None:
+        # sparse MAC-window mode only (the windowed / grav_window=0
+        # lowering stays byte-identical): device-measured TRUE remote
+        # row need + per-distance cap occupancy, folded to the schema-v7
+        # gravity-stage exchange telemetry by _gravity_sharded_stage
+        diagnostics["halo_rows"] = grav_halo_metrics["halo_rows"]
+        diagnostics["halo_occ"] = grav_halo_metrics["halo_occ"]
     if with_phi:
         return ax, ay, az, phi, diagnostics
     egrav = 0.5 * jnp.sum(m * phi)

@@ -65,7 +65,8 @@ def _place_aux_leaf(leaf, n: int, place, pspec, rspec):
 
 
 def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
-                      halo_window: int = 0, halo_cells=(), aux_cfg=None):
+                      halo_window: int = 0, halo_cells=(), grav_cells=(),
+                      aux_cfg=None):
     """Jit the full step with particle arrays sharded over the mesh.
 
     GSPMD partitions the entire program: the SFC sort's key exchange is the
@@ -77,7 +78,10 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
     When ``cfg.gravity`` is set, the returned stepper takes the gravity
     tree as a third argument: ``stepper(state, box, gtree)``; the (small)
     tree arrays stay replicated across the mesh, matching the reference's
-    replicated global octree (assignment.hpp:51-53).
+    replicated global octree (assignment.hpp:51-53). ``grav_cells``
+    (P-1 per-distance row caps from sizing.device_gravity_halo) switches
+    the gravity near field to the MAC-sized sparse serve; empty ships
+    full peer slabs.
 
     turb-ve / std-cooling carry extra per-step state through the stepper
     (the reference runs every propagator under the full MPI domain,
@@ -115,7 +119,8 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
         if step_fn in ({step_hydro_std, step_hydro_ve} | carry_props):
             cfg = dataclasses.replace(cfg, mesh=mesh, shard_axis="p",
                                       halo_window=halo_window,
-                                      halo_cells=tuple(halo_cells))
+                                      halo_cells=tuple(halo_cells),
+                                      grav_cells=tuple(grav_cells))
         else:
             cfg = dataclasses.replace(cfg, backend="xla")
     if (cfg.gravity is not None and cfg.gravity.use_pallas
@@ -193,4 +198,7 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
     cache_size = getattr(jitted, "_cache_size", None)
     if cache_size is not None:
         stepper._cache_size = cache_size
+    # ...and the jitted callable itself, so tests can .lower() the step
+    # and pin lowering identities (the grav_window=0 byte-identity gate)
+    stepper._jitted = jitted
     return stepper

@@ -271,6 +271,103 @@ def device_sparse_halo(x, y, z, h, keys, box, nbr, P: int,
 
 
 # ---------------------------------------------------------------------------
+# gravity near-field (MAC) sizing
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("meta", "theta", "P"))
+def gravity_need_matrix(xs, ys, zs, ms, skeys, box, tree, meta,
+                        theta: float, P: int, shifts=None):
+    """(P_dest, P_src) row-need matrix of the sparse gravity near-field
+    exchange: entry [k, j] = rows of shard j's slab whose leaf cells FAIL
+    the monotone MAC opening test against shard k's slab bbox — dest k's
+    P2P essential set (the Warren-Salmon LET boundary). Everything the
+    slab bbox accepts is already covered by M2P on the replicated coarse
+    tree, so those rows never cross the wire.
+
+    Conservative by the monotone vector MAC (traversal.py
+    ``_monotone_mac_geometry``): the accept region only GROWS as the
+    target bbox shrinks, so a leaf opened by any in-slab target block
+    (or LET / bitmask-superblock classification) is opened by the whole
+    slab bbox too — the in-step ``need > cap`` escape can only fire
+    after genuine drift. ``shifts`` ((ns, 3), optional) unions the
+    opened set over the Ewald replica offsets; ``compute_gravity`` adds
+    a shift to the TARGET positions and the shell set is symmetric, so
+    ``bc + shift`` covers every replica pass. Inputs are the SORTED
+    gravity arrays (``skeys`` ascending) so slab k of ``reshape(P, S)``
+    is shard k's key slab."""
+    from sphexa_tpu.gravity.traversal import (
+        _monotone_mac_geometry,
+        compute_multipoles,
+    )
+    from sphexa_tpu.parallel.exchange import _sparse_layout
+
+    n = xs.shape[0]
+    if n % P:
+        raise ValueError(f"gravity halo sizing needs n % P == 0 "
+                         f"(shard_state's contract), got {n} % {P}")
+    S = n // P
+    node_mass, node_com, _, edges = compute_multipoles(
+        xs, ys, zs, ms, skeys, tree, meta, order=0
+    )
+    valid = node_mass > 0
+    gc, gs, mac2 = _monotone_mac_geometry(box, tree, meta, node_com,
+                                          valid, theta)
+    slab = lambda a: a.reshape(P, S)
+    bmin = jnp.stack([slab(a).min(axis=1) for a in (xs, ys, zs)], axis=1)
+    bmax = jnp.stack([slab(a).max(axis=1) for a in (xs, ys, zs)], axis=1)
+    bc, bs = 0.5 * (bmax + bmin), 0.5 * (bmax - bmin)  # (P, 3)
+
+    def opened_from(center):
+        d = jnp.maximum(
+            jnp.abs(center[:, None, :] - gc[None, :, :])
+            - bs[:, None, :] - gs[None, :, :], 0.0)
+        return jnp.sum(d * d, axis=2) < mac2[None, :]  # (P, num_nodes)
+
+    opened = opened_from(bc)
+    if shifts is not None:
+        for i in range(shifts.shape[0]):
+            opened = opened | opened_from(bc + shifts[i][None, :])
+    cov = opened[:, tree.node_of_leaf]  # (P_dest, num_leaves)
+    return jax.vmap(lambda c: _sparse_layout(c, edges, S, P)[2])(cov)
+
+
+@functools.partial(jax.jit, static_argnames=("meta", "theta", "P"))
+def _gravity_halo_needs(xs, ys, zs, ms, skeys, box, tree, meta,
+                        theta: float, P: int, shifts=None):
+    """(P-1,) per-DISTANCE gravity row needs: entry r-1 = max over
+    shards j of the rows shard (j+r)%P needs from j (serve_sparse ships
+    round r in a buffer of exactly this size) — the per-distance fold of
+    ``gravity_need_matrix``, mirroring ``_sparse_halo_needs``."""
+    need = gravity_need_matrix(xs, ys, zs, ms, skeys, box, tree, meta,
+                               theta, P, shifts)
+    j = jnp.arange(P, dtype=jnp.int32)
+    return jnp.stack(
+        [need[(j + r) % P, j].max() for r in range(1, P)]
+    )  # (P-1,)
+
+
+def device_gravity_halo(xs, ys, zs, ms, skeys, box, tree, meta,
+                        theta: float, P: int, shifts=None,
+                        margin: float = 1.4, quantum: int = 256,
+                        ) -> Tuple[int, ...]:
+    """Size the sparse gravity near-field exchange's static per-distance
+    row caps (the hmax tuple compute_gravity's sparse shard path hands
+    to exchange.serve_sparse). P-1 scalars to the host. A cap padded to
+    S ships the full slab for that distance — the retry ceiling, where
+    need <= S guarantees the escape sentinel cannot fire."""
+    n = xs.shape[0]
+    S = n // P
+    per_r = np.asarray(fetch(_gravity_halo_needs(
+        xs, ys, zs, ms, skeys, box, tree, meta, theta, P, shifts
+    )))
+    pad = lambda v: min(
+        int(-(-int(max(int(v), 1) * margin) // quantum) * quantum), S
+    )
+    return tuple(pad(v) for v in per_r)
+
+
+# ---------------------------------------------------------------------------
 # distributed gravity-tree build (histogram pyramid + drill-down)
 # ---------------------------------------------------------------------------
 

@@ -72,6 +72,14 @@ STEP_DIAG_KEYS = ("dt", "nc_mean", "nc_max", "occupancy", "rho_max",
 #: the pallas fast path; consumers must .get() them.
 SHARD_DIAG_KEYS = ("shard_rows", "shard_occ", "shard_work", "shard_trips")
 
+#: gravity-stage analog of SHARD_DIAG_KEYS: per-shard (P,) TRUE remote
+#: row need + per-distance cap occupancy of the MAC-sized sparse gravity
+#: near-field exchange (schema-v7 ``stage="gravity"`` exchange /
+#: shard_load events). Present only when ``cfg.grav_cells`` sizes the
+#: sparse serve — the windowed / full-slab gravity path emits neither,
+#: keeping its lowering byte-identical.
+GRAV_SHARD_DIAG_KEYS = ("gshard_rows", "gshard_occ")
+
 #: OBS_DIAG_KEYS / NUM_DIAG_KEYS (imported above) complete the diag-key
 #: families: the in-graph science ledger's conservation and
 #: numerics-health scalars (observables/ledger.py) ride the diagnostics
@@ -161,6 +169,11 @@ class PropagatorConfig:
     # sum(halo_cells) rows per serve and tracks the halo surface instead
     # of degenerating to whole slabs (docs/NEXT.md round-4 measurement)
     halo_cells: Tuple[int, ...] = ()
+    # MAC-sized sparse gravity near-field exchange: P-1 per-DISTANCE row
+    # caps (parallel/sizing.device_gravity_halo) for the leaf-granular
+    # serve inside compute_gravity's shard path. () = full peer slabs
+    # (the grav_window=0 fallback and the escape-retry ceiling)
+    grav_cells: Tuple[int, ...] = ()
     # persistent-neighbor-list mode (sph/pair_lists.py): > 0 enables it
     # with this per-group chunk-slot budget; steady steps then skip the
     # global sort AND the candidate prologue, momentum ops lane-compact,
@@ -322,24 +335,48 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
     axis = cfg.shard_axis
     P = cfg.mesh.shape[axis]
     S_shard = state.x.shape[0] // P
-    # full-slab windows: cfg.halo_window is sized from SPH 2h candidate
-    # spans, but the near field reaches the MAC radius (~2*leaf_edge/theta
-    # >> 2h) — an SPH-sized window would escape persistently and the
-    # retry loop could not converge by growing it. A measured
-    # gravity-specific window estimate is the open refinement
-    # (docs/NEXT.md); full slabs are always correct.
-    Wmax = S_shard
+    # near-field halo sizing: cfg.grav_cells (MAC-need per-distance row
+    # caps from sizing.device_gravity_halo — the Warren-Salmon essential
+    # set) selects the sparse leaf-granular serve; empty falls back to
+    # full-slab windows, which are always correct and are the
+    # escape-retry ceiling. cfg.halo_window is never reused here: it is
+    # sized from SPH 2h candidate spans while the near field reaches the
+    # MAC radius (~2*leaf_edge/theta >> 2h), so an SPH-sized window
+    # would escape persistently and the retry loop could not converge.
+    if cfg.grav_cells:
+        win = tuple(min(int(c), S_shard) for c in cfg.grav_cells)
+    else:
+        win = S_shard
     gcfg = dataclasses.replace(cfg.gravity, G=cfg.const.g, use_pallas=True)
+
+    def _finish(gx, gy, gz, egrav, diag):
+        # per-shard exchange telemetry rides OUTSIDE the pmax fold (the
+        # schema-v7 gravity-stage events need the (P,) vectors, not the
+        # max); the all_gather chains on diag["p2p_max"] — the LAST link
+        # of _chain_stage_reductions' sorted chain — extending the
+        # JXA201 total order instead of forking it
+        grows = diag.pop("halo_rows", None)
+        gocc = diag.pop("halo_occ", None)
+        egrav, diag = _chain_stage_reductions(egrav, diag, axis)
+        if grows is not None:
+            from sphexa_tpu.parallel.exchange import chain_after
+
+            packed = jnp.stack([grows.astype(jnp.float32), gocc])
+            g = jax.lax.all_gather(
+                chain_after(packed, diag["p2p_max"]), axis
+            )
+            diag["gshard_rows"] = g[:, 0].astype(jnp.int32)
+            diag["gshard_occ"] = g[:, 1]
+        return gx, gy, gz, egrav, diag
 
     if cfg.ewald is not None:
 
         def stage(box, keys, x, y, z, m, h):
             gx, gy, gz, egrav, diag = compute_gravity_ewald(
                 x, y, z, m, h, keys, box, gtree, cfg.grav_meta, gcfg,
-                cfg.ewald, shard=(axis, P, Wmax),
+                cfg.ewald, shard=(axis, P, win),
             )
-            egrav, diag = _chain_stage_reductions(egrav, diag, axis)
-            return gx, gy, gz, egrav, diag
+            return _finish(gx, gy, gz, egrav, diag)
 
         dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
                  "leaf_occ": PartitionSpec(), "c_max": PartitionSpec(),
@@ -354,16 +391,18 @@ def _gravity_sharded_stage(state, box, cfg, gtree, keys):
             )
             gx, gy, gz, egrav, diag = compute_gravity(
                 x, y, z, m, h, keys, box, gtree, cfg.grav_meta, gcfg,
-                mp_cache=mpc, shard=(axis, P, Wmax),
+                mp_cache=mpc, shard=(axis, P, win),
             )
-            egrav, diag = _chain_stage_reductions(egrav, diag, axis)
-            return gx, gy, gz, egrav, diag
+            return _finish(gx, gy, gz, egrav, diag)
 
         dspec = {"m2p_max": PartitionSpec(), "p2p_max": PartitionSpec(),
                  "leaf_occ": PartitionSpec(), "c_max": PartitionSpec(),
                  "let_max": PartitionSpec(),
                  "compact_width": PartitionSpec(),
                  "mac_work_ratio": PartitionSpec()}
+    if isinstance(win, tuple):
+        dspec = dict(dspec, **{k: PartitionSpec()
+                               for k in GRAV_SHARD_DIAG_KEYS})
 
     Pp, Pr = PartitionSpec(axis), PartitionSpec()
     return shard_map(

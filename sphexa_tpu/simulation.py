@@ -9,7 +9,7 @@ when particle motion invalidates it — the rare recompile boundary — and
 
 import dataclasses
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 import jax
@@ -18,7 +18,6 @@ import jax.numpy as jnp
 from sphexa_tpu.telemetry import Telemetry, emit_memory_event
 
 from sphexa_tpu.gravity.traversal import GravityConfig, estimate_gravity_caps
-from sphexa_tpu.gravity.tree import build_gravity_tree
 from sphexa_tpu.neighbors.cell_list import (
     NeighborConfig,
     choose_grid_level,
@@ -308,6 +307,8 @@ class Simulation:
         use_lists: bool = True,
         list_skin_rel: Optional[float] = None,
         halo_mode: str = "sparse",
+        grav_window: Optional[int] = None,
+        grav_window_margin: Optional[float] = None,
         m2p_cap_margin: Optional[float] = None,
         donate: object = "auto",
         debug_checks: bool = False,
@@ -344,6 +345,8 @@ class Simulation:
                               ("list_skin_rel", list_skin_rel),
                               ("m2p_cap_margin", m2p_cap_margin),
                               ("check_every", check_every),
+                              ("grav_window", grav_window),
+                              ("grav_window_margin", grav_window_margin),
                               ("dt_bins", dt_bins),
                               ("bin_sync_every", bin_sync_every),
                               ("bin_resort_drift", bin_resort_drift))
@@ -365,6 +368,18 @@ class Simulation:
         list_skin_rel = _knob("list_skin_rel", 0.2)
         m2p_cap_margin = _knob("m2p_cap_margin", 1.3)
         check_every = _knob("check_every", 1)
+        # MAC-sized sparse gravity near field (parallel/sizing.
+        # device_gravity_halo): grav_window is the per-distance cap
+        # padding quantum in rows (caps cache across retries at its
+        # multiples); 0 = ship full peer slabs (the pre-sizing behavior,
+        # byte-identical lowering). grav_window_margin pads the measured
+        # MAC need and is GROWN 1.5x per escape-sentinel trip, with full
+        # slabs as the retry ceiling.
+        self.grav_window = int(_knob("grav_window", 256))
+        if self.grav_window < 0:
+            raise ValueError(
+                f"grav_window must be >= 0, got {self.grav_window}")
+        self._grav_halo_margin = float(_knob("grav_window_margin", 1.4))
         # hierarchical block time steps (sph/blockdt.py): dt_bins=None is
         # today's global-dt path, bitwise unchanged; dt_bins=1 runs the
         # blockdt machinery pinned bitwise-equal to it (tests/
@@ -422,6 +437,11 @@ class Simulation:
         # static shape of the active halo exchange (mode + shipped rows),
         # stamped by _configure_sharded for the exchange events
         self._halo_info: Optional[Dict] = None
+        # gravity-stage analog (schema-v7 stage="gravity" events): the
+        # MAC-sized sparse near-field caps + volume, or the full-slab
+        # fallback's shape; None when no explicit gravity exchange runs
+        self._grav_halo_info: Optional[Dict] = None
+        self._grav_cells: Tuple[int, ...] = ()
         self._mem_post_compile = False  # one "post-compile" HBM snapshot
         # physics observability (schema v3): the in-graph science ledger
         # (propagator OBS/NUM_DIAG_KEYS) is fetched with the step
@@ -657,19 +677,30 @@ class Simulation:
             jax.block_until_ready(jax.tree.leaves(self.state))
         # multi-device: every sizing statistic comes from jitted device
         # reductions (O(N/P) transfers, parallel/sizing.py); single-device
-        # keeps the native C++ host sizing pass. When self-gravity also
-        # needs device keys, compute keygen+argsort over N ONCE here and
-        # hand it to both consumers (sizing_stats used to run its own
-        # pair — the round-4 reviewer's double-keygen finding).
+        # keeps the native C++ host sizing pass. Multi-device consumers
+        # of device keys (sizing_stats, the gravity tree build/need
+        # sizing, AND _configure_sharded's halo-need scan) share ONE
+        # keygen+argsort over N computed here (the round-4 reviewer's
+        # double-keygen finding — _configure_sharded used to redo the
+        # pair). Keys are generated against the make_global_box fit so
+        # the shared cache matches what the halo scan keyed on; open
+        # dims only ever expand to the particle extrema, so on a
+        # post-step state (box already refit by the step prologue) the
+        # values coincide with self.box.
         sizing_cache = None
-        if self._mesh is not None and self.gravity_on:
+        if self.gravity_on or (self._mesh is not None
+                               and self._halo_sizing_needed()):
+            from sphexa_tpu.sfc.box import make_global_box
             from sphexa_tpu.sfc.keys import compute_sfc_keys
 
+            gbox = make_global_box(
+                self.state.x, self.state.y, self.state.z, self.box
+            )
             keys_d = compute_sfc_keys(
-                self.state.x, self.state.y, self.state.z, self.box,
+                self.state.x, self.state.y, self.state.z, gbox,
                 curve=self.curve,
             )
-            sizing_cache = (keys_d, jnp.argsort(keys_d))
+            sizing_cache = (keys_d, jnp.argsort(keys_d), gbox)
         self._cfg = make_propagator_config(
             self.state, self.box, self.const,
             ngmax=self.ngmax, block=self.block, curve=self.curve, min_cap=min_cap,
@@ -679,7 +710,7 @@ class Simulation:
             use_lists=self._lists_eligible,
             list_skin_rel=self._list_skin_rel,
             list_slot_margin=self._slot_margin,
-            sizing_cache=sizing_cache,
+            sizing_cache=sizing_cache[:2] if sizing_cache else None,
             obs_spec=self._obs_spec,
             dt_bins=self.dt_bins, bin_sync_every=self.bin_sync_every,
             bin_resort_drift=self.bin_resort_drift,
@@ -690,13 +721,27 @@ class Simulation:
         if self.gravity_on:
             self._configure_gravity(grav_margin, keys_cache=sizing_cache)
         if self._mesh is not None:
-            self._configure_sharded()
+            self._configure_sharded(sizing_cache)
 
-    def _configure_sharded(self):
+    def _halo_sizing_needed(self) -> bool:
+        """Whether _configure_sharded will run the explicit halo-need
+        scan (pallas fast path) — i.e. whether it consumes device keys
+        and should share _configure_impl's keygen cache."""
+        if self.prop_name == "nbody":
+            return False
+        backend = self.backend
+        if backend == "auto":
+            backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+        return backend == "pallas"
+
+    def _configure_sharded(self, sizing_cache=None):
         """(Re)build the sharded stepper: size the per-peer halo window
         from the current distribution (estimate_halo_window) and bind it
         into make_sharded_step. Called at every reconfiguration, so an
-        escape-sentinel overflow grows the window via _halo_margin."""
+        escape-sentinel overflow grows the window via _halo_margin.
+        ``sizing_cache``: _configure_impl's shared (keys, order, gbox)
+        so the halo-need scan reuses the one keygen+argsort over N
+        instead of redoing it (the round-4 double-keygen finding)."""
         from sphexa_tpu.parallel import make_sharded_step
         from sphexa_tpu.sfc.box import make_global_box
 
@@ -713,8 +758,12 @@ class Simulation:
             from sphexa_tpu.sfc.keys import compute_sfc_keys
 
             s = self.state
-            gbox = make_global_box(s.x, s.y, s.z, self.box)
-            keys = compute_sfc_keys(s.x, s.y, s.z, gbox, curve=self.curve)
+            if sizing_cache is not None:
+                keys, _, gbox = sizing_cache
+            else:
+                gbox = make_global_box(s.x, s.y, s.z, self.box)
+                keys = compute_sfc_keys(s.x, s.y, s.z, gbox,
+                                        curve=self.curve)
             if self._halo_mode == "sparse":
                 hcells = device_sparse_halo(
                     s.x, s.y, s.z, s.h, keys, gbox, self._cfg.nbr,
@@ -751,57 +800,65 @@ class Simulation:
             self._halo_info = {"mode": "gspmd", "shipped_rows": 0}
         self._halo_info["bytes_per_step"] = (
             self._halo_info["shipped_rows"] * nf * 4)
+        # gravity-stage exchange shape (schema-v7 stage="gravity"
+        # events): the explicit near-field serve runs only on the pallas
+        # fast path (the GSPMD/nbody fallback leaves collectives to XLA)
+        self._grav_halo_info = None
+        if (self.gravity_on and self._cfg.backend == "pallas"
+                and self.prop_name != "nbody"):
+            # the Ewald replica loop serves once per shell — the volume
+            # accounting scales with the static shell count
+            nshell = 1
+            if self._cfg.ewald is not None:
+                r = self._cfg.ewald.num_replica_shells
+                nshell = (2 * r + 1) ** 3
+            if self._grav_cells:
+                caps = tuple(min(int(c), S) for c in self._grav_cells)
+                shipped = int(sum(caps))
+                self._grav_halo_info = {"mode": "sparse", "caps": caps,
+                                        "shipped_rows": shipped}
+            else:
+                self._grav_halo_info = {"mode": "windowed", "wmax": S,
+                                        "shipped_rows": (P - 1) * S}
+            # 5 served fields (x, y, z, m, h) x f32
+            self._grav_halo_info["bytes_per_step"] = (
+                self._grav_halo_info["shipped_rows"] * 5 * 4 * nshell)
         self._stepper = make_sharded_step(
             self._mesh, self._cfg, self._step_fn(),
-            halo_window=wmax, halo_cells=hcells, aux_cfg=aux_cfg,
+            halo_window=wmax, halo_cells=hcells,
+            grav_cells=self._grav_cells, aux_cfg=aux_cfg,
         )
 
     def _configure_gravity(self, margin: float, keys_cache=None):
         """(Re)build the gravity tree structure from the current particle
         distribution and size the interaction-list caps (the gravity analog
         of re-sizing the neighbor cell grid — reconfiguration granularity
-        only). Single-device: native C++ host keygen/sort + host tree
-        build. Multi-device: the distributed histogram-pyramid build
-        (parallel/sizing.py — the update_mpi.hpp node-count allreduce
-        transposed) plus device-side sort/multipoles, so only O(#cells)
-        histograms and O(tree) arrays ever reach the host; ``keys_cache``
-        carries _configure's (keys, order) so keygen+argsort over N runs
-        once per reconfigure, not once per consumer."""
+        only). The histogram-pyramid device build
+        (sizing.leaf_array_from_device_keys — the update_mpi.hpp
+        node-count allreduce transposed) plus device-side sort/multipoles
+        is the ONLY build path, single- and multi-device alike, so only
+        O(#cells) histograms and O(tree) arrays ever reach the host; the
+        host-numpy ``build_gravity_tree`` survives purely as the test
+        oracle the pyramid is pinned equal to. ``keys_cache`` carries
+        _configure's (keys, order) so keygen+argsort over N runs once per
+        reconfigure, not once per consumer."""
         s = self.state
-        if self._mesh is not None:
-            from sphexa_tpu.gravity.tree import linkage_from_leaves
-            from sphexa_tpu.parallel.sizing import leaf_array_from_device_keys
-            from sphexa_tpu.sfc.keys import compute_sfc_keys
+        from sphexa_tpu.gravity.tree import linkage_from_leaves
+        from sphexa_tpu.parallel.sizing import leaf_array_from_device_keys
+        from sphexa_tpu.sfc.keys import compute_sfc_keys
 
-            if keys_cache is not None:
-                keys_d, order = keys_cache
-            else:
-                keys_d = compute_sfc_keys(s.x, s.y, s.z, self.box,
-                                          curve=self.curve)
-                order = jnp.argsort(keys_d)
-            leaf_tree = leaf_array_from_device_keys(
-                keys_d, bucket_size=self.grav_bucket
-            )
-            gtree, meta = linkage_from_leaves(leaf_tree, curve=self.curve)
-            skeys = keys_d[order]
-            xs, ys, zs, ms = s.x[order], s.y[order], s.z[order], s.m[order]
+        if keys_cache is not None:
+            keys_d, order = keys_cache[0], keys_cache[1]
         else:
-            from sphexa_tpu import native
-
-            keys = native.compute_keys(
-                np.asarray(s.x), np.asarray(s.y), np.asarray(s.z),
-                np.asarray(self.box.lo), np.asarray(self.box.lengths),
-                self.curve,
-            )
-            order = native.argsort_keys(keys)
-            skeys = jnp.asarray(keys[order])
-            xs = jnp.asarray(np.asarray(s.x)[order])
-            ys = jnp.asarray(np.asarray(s.y)[order])
-            zs = jnp.asarray(np.asarray(s.z)[order])
-            ms = jnp.asarray(np.asarray(s.m)[order])
-            gtree, meta = build_gravity_tree(
-                keys[order], bucket_size=self.grav_bucket, curve=self.curve
-            )
+            keys_d = compute_sfc_keys(s.x, s.y, s.z, self.box,
+                                      curve=self.curve)
+            order = jnp.argsort(keys_d)
+        leaf_tree = leaf_array_from_device_keys(
+            keys_d, bucket_size=self.grav_bucket
+        )
+        gtree, meta = linkage_from_leaves(leaf_tree, curve=self.curve)
+        skeys = keys_d[order]
+        xs, ys, zs, ms = s.x[order], s.y[order], s.z[order], s.m[order]
         # scale-dependent solver shape (target_block / hierarchical
         # bitmask compaction at >= 500k, gravity_tuning) — bench.py uses
         # the same helper so the benchmarked config IS this one
@@ -836,17 +893,48 @@ class Simulation:
             from sphexa_tpu.gravity.ewald import EwaldConfig
 
             ewald = EwaldConfig()
+        # MAC-need sizing of the sparse gravity near-field exchange
+        # (parallel/sizing.device_gravity_halo — the Warren-Salmon
+        # essential-set volume): per-distance row caps for the leaf-
+        # granular serve inside compute_gravity's shard path. Skipped at
+        # grav_window=0 (full peer slabs, the pre-sizing lowering) and
+        # on the GSPMD fallback, where no explicit serve runs.
+        self._grav_cells = ()
+        if (self._mesh is not None and self._mesh.size > 1
+                and self.grav_window > 0 and self._halo_sizing_needed()):
+            from itertools import product
+
+            from sphexa_tpu.parallel.sizing import device_gravity_halo
+
+            shifts = None
+            if ewald is not None:
+                # union the opened set over the replica-shell offsets:
+                # a shifted target slab reaches wrap-around leaves the
+                # base pass never opens
+                r = ewald.num_replica_shells
+                shells = np.array(
+                    [sh for sh in product(range(-r, r + 1), repeat=3)],
+                    np.float32,
+                )
+                shifts = jnp.asarray(shells) * self.box.lengths[0]
+            self._grav_cells = device_gravity_halo(
+                xs, ys, zs, ms, skeys, self.box, gtree, meta,
+                theta=self.theta, P=self._mesh.size, shifts=shifts,
+                margin=self._grav_halo_margin, quantum=self.grav_window,
+            )
         self._cfg = dataclasses.replace(
             self._cfg, gravity=gcfg, grav_meta=meta, ewald=ewald
         )
 
     def _gravity_overflowed(self, diagnostics) -> bool:
-        # the sharded near field always runs full-slab halo windows
-        # (_gravity_sharded_stage) and the run splitter sizes its slots
-        # from the mesh (exchange._split_runs extra=max(8, P-1)), so its
-        # escape sentinel cannot fire — any p2p_max > p2p_cap here is a
+        # with full-slab windows (grav_cells empty) the near field's
+        # escape sentinel cannot fire — the run splitter sizes its slots
+        # from the mesh (exchange._split_runs extra=max(8, P-1)) and
+        # every remote row is in reach — so any p2p_max > p2p_cap is a
         # REAL interaction-list overflow and cap regrowth is the right
-        # recovery
+        # recovery. Under the MAC-sized sparse serve the sentinel CAN
+        # fire (encoded as p2p_cap + 1, see _grav_window_blown): the
+        # recovery is then a halo-margin regrowth, not a cap ratchet.
         if not self.gravity_on:
             return False
         g = self._cfg.gravity
@@ -858,6 +946,18 @@ class Simulation:
             or (g.let_cap > 0
                 and int(diagnostics.get("let_max", 0)) > g.let_cap)
         )
+
+    def _grav_window_blown(self, diagnostics) -> bool:
+        """The MAC-sized gravity serve's escape sentinel: exactly
+        p2p_cap + 1 while the sparse caps are active. Same cap+1
+        ambiguity contract as the SPH window sentinel (occupancy ==
+        nbr.cap + 1): a real overflow landing exactly on cap+1 is
+        handled identically — the margin regrowth converges to full
+        slabs, where need <= S guarantees the sentinel cannot fire and
+        a persisting overflow is then re-attributed to the caps."""
+        if not self.gravity_on or not self._grav_cells:
+            return False
+        return int(diagnostics["p2p_max"]) == self._cfg.gravity.p2p_cap + 1
 
     def _config_still_valid(self, diagnostics) -> bool:
         nbr = self._cfg.nbr
@@ -1032,8 +1132,10 @@ class Simulation:
                     self.cooling_cfg)
         if self._mesh is not None:
             info = self._halo_info or {}
+            ginfo = self._grav_halo_info or {}
             return ("sharded", self.prop_name, self._cfg,
-                    info.get("caps"), info.get("wmax"))
+                    info.get("caps"), info.get("wmax"),
+                    ginfo.get("caps"), ginfo.get("wmax"))
         return (self.prop_name, self._cfg, self.turb_cfg,
                 self.cooling_cfg, donate_now,
                 self._use_lists and self._lists is not None)
@@ -1160,12 +1262,13 @@ class Simulation:
         (SHARD_DIAG_KEYS) and (B,) bin populations (BLOCKDT_DIAG_KEYS) —
         everything the flush boundary fetches in one batch. Per-particle
         arrays (keep_fields/keep_accels) stay on device."""
-        from sphexa_tpu.propagator import BLOCKDT_DIAG_KEYS, SHARD_DIAG_KEYS
+        from sphexa_tpu.propagator import (
+            BLOCKDT_DIAG_KEYS, GRAV_SHARD_DIAG_KEYS, SHARD_DIAG_KEYS)
 
         return {
             k: v for k, v in diagnostics.items()
             if getattr(v, "ndim", 0) == 0 or k in SHARD_DIAG_KEYS
-            or k in BLOCKDT_DIAG_KEYS
+            or k in BLOCKDT_DIAG_KEYS or k in GRAV_SHARD_DIAG_KEYS
         }
 
     @classmethod
@@ -1206,7 +1309,7 @@ class Simulation:
         # discarded before any emit; halo_trips is counted at the ONE
         # place that sees the sentinel (_reconfigure_after_overflow)
         load = {"it": self.iteration, "steps": steps,
-                "particles": particles}
+                "particles": particles, "stage": "sph"}
         if work is not None:
             load["work"] = [float(w) for w in work]
         tel.event("shard_load", **load)
@@ -1221,6 +1324,24 @@ class Simulation:
                                               for o in occ],
                 bytes_per_step=int(info.get("bytes_per_step", 0)),
                 trips=int(tel.counters.get("halo_trips", 0)),
+                stage="sph",
+            )
+        # schema-v7: the gravity near field gets its own exchange event
+        # when the MAC-sized sparse serve is active (gshard_* diagnostics
+        # present) — same fetch, zero added syncs
+        grows, gocc = arr("gshard_rows"), arr("gshard_occ")
+        ginfo = self._grav_halo_info or {}
+        if grows is not None:
+            tel.event(
+                "exchange", it=self.iteration, steps=steps,
+                mode=ginfo.get("mode", "?"),
+                shipped_rows=int(ginfo.get("shipped_rows", 0)),
+                rows=[int(r) for r in grows],
+                occ=None if gocc is None else [round(float(o), 4)
+                                               for o in gocc],
+                bytes_per_step=int(ginfo.get("bytes_per_step", 0)),
+                trips=int(tel.counters.get("grav_halo_trips", 0)),
+                stage="gravity",
             )
         # the watchdog: max/mean per metric against the configured ratio
         for metric, a in (("work", work), ("halo_rows", rows),
@@ -1443,6 +1564,7 @@ class Simulation:
         never corrupt state."""
         reconfigured = False
         grav_margin = 1.5
+        grav_blown_once = False
         t0 = time.perf_counter()
         for _attempt in range(4):
             out = self._launch()
@@ -1453,7 +1575,20 @@ class Simulation:
                 # stale persistent lists: discard + rebuild (no re-size)
                 self._rebuild_lists()
                 continue
-            if self._gravity_overflowed(diagnostics):
+            if self._grav_window_blown(diagnostics):
+                # escaped sparse near-field runs (the cap+1 sentinel):
+                # grow the MAC-need margin so the re-size converges —
+                # NOT the interaction-list caps, which would recompile a
+                # bigger engine for a comm problem. A second trip within
+                # one step jumps straight to the full-slab ceiling
+                # (caps == S, where the sentinel provably cannot fire)
+                # so convergence fits the 4-attempt budget.
+                self._grav_halo_margin = (
+                    1e9 if grav_blown_once else self._grav_halo_margin * 1.5
+                )
+                grav_blown_once = True
+                self.telemetry.count("grav_halo_trips")
+            elif self._gravity_overflowed(diagnostics):
                 grav_margin *= 1.5
             self._reconfigure_after_overflow(diagnostics, grav_margin)
             reconfigured = True
@@ -1612,8 +1747,16 @@ class Simulation:
             # expiry only: fresh lists on the rolled-back state suffice
             self._rebuild_lists()
         else:
-            grav_margin = 1.5 * (
-                1.5 if self._gravity_overflowed(diag_bad) else 1.0)
+            grav_margin = 1.5
+            if self._grav_window_blown(diag_bad):
+                # escaped sparse gravity runs (cap+1 sentinel): regrow
+                # the MAC-need margin, not the interaction-list caps.
+                # The replay below goes through _step_checked, which
+                # escalates to the full-slab ceiling on a repeat trip.
+                self._grav_halo_margin *= 1.5
+                self.telemetry.count("grav_halo_trips")
+            elif self._gravity_overflowed(diag_bad):
+                grav_margin = 1.5 * 1.5
             self._reconfigure_after_overflow(diag_bad, grav_margin)
         for _ in range(len(pending)):
             result = self._step_checked()

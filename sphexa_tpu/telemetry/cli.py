@@ -254,10 +254,15 @@ def summarize_shards(run_dir: str) -> Dict:
     """Aggregate the distributed (schema-v2) events of one run into the
     per-shard view: load/work per shard, halo-exchange volume and
     occupancy percentiles, escape trips, imbalance-watchdog hits, and
-    per-device HBM snapshots."""
+    per-device HBM snapshots. Schema-v7 stages the exchange records:
+    events with ``stage == "gravity"`` (the MAC-sized sparse gravity
+    serve) aggregate into their own block next to the SPH one; pre-v7
+    events carry no stage and read as SPH."""
     events, problems = load_events(run_dir)
     loads = _of_kind(events, "shard_load")
-    exchanges = _of_kind(events, "exchange")
+    all_ex = _of_kind(events, "exchange")
+    exchanges = [e for e in all_ex if e.get("stage", "sph") != "gravity"]
+    gexchanges = [e for e in all_ex if e.get("stage") == "gravity"]
     memories = _of_kind(events, "memory")
     imbalances = _of_kind(events, "imbalance")
 
@@ -265,10 +270,12 @@ def summarize_shards(run_dir: str) -> Dict:
     work = _per_shard_matrix(loads, "work")
     rows = _per_shard_matrix(exchanges, "rows")
     occ = _per_shard_matrix(exchanges, "occ")
+    grows = _per_shard_matrix(gexchanges, "rows")
+    gocc = _per_shard_matrix(gexchanges, "occ")
 
     shards: List[Dict] = []
     P = 0
-    for m in (particles, work, rows, occ):
+    for m in (particles, work, rows, occ, grows, gocc):
         if m is not None:
             P = max(P, m.shape[1])
     for s in range(P):
@@ -276,6 +283,8 @@ def summarize_shards(run_dir: str) -> Dict:
         w = col(work)
         r = col(rows)
         o = col(occ)
+        gr = col(grows)
+        go = col(gocc)
         shards.append({
             "shard": s,
             "particles": int(particles[-1, s]) if particles is not None
@@ -284,12 +293,25 @@ def summarize_shards(run_dir: str) -> Dict:
             "rows_mean": float(r.mean()) if r is not None else None,
             "occ_p95": float(np.percentile(o, 95)) if o is not None
             else None,
+            "grav_rows_mean": float(gr.mean()) if gr is not None else None,
+            "grav_occ_p95": float(np.percentile(go, 95)) if go is not None
+            else None,
         })
     if work is not None and all(s["work_mean"] is not None for s in shards):
         total = sum(s["work_mean"] for s in shards) or 1.0
         for s in shards:
             s["work_share"] = s["work_mean"] / total
     last_ex = exchanges[-1] if exchanges else {}
+    last_gex = gexchanges[-1] if gexchanges else {}
+    gravity = None
+    if gexchanges:
+        gravity = {
+            "windows": len(gexchanges),
+            "mode": last_gex.get("mode"),
+            "shipped_rows": last_gex.get("shipped_rows"),
+            "bytes_per_step": last_gex.get("bytes_per_step"),
+            "trips": last_gex.get("trips", 0),
+        }
     # imbalance ratios over the run: max/mean of work per event row
     ratios = []
     if work is not None:
@@ -305,6 +327,7 @@ def summarize_shards(run_dir: str) -> Dict:
         "shipped_rows": last_ex.get("shipped_rows"),
         "bytes_per_step": last_ex.get("bytes_per_step"),
         "trips": last_ex.get("trips", 0),
+        "gravity": gravity,
         "imbalance_events": len(imbalances),
         "work_ratio_p95": float(np.percentile(ratios, 95)) if ratios
         else None,
@@ -654,19 +677,27 @@ def render_shards(s: Dict) -> str:
                      "(single-device, or a pre-v2 writer)")
         return "\n".join(lines)
     fmt = lambda v, f="{:.3g}": "-" if v is None else f.format(v)
+    # gravity-stage columns render only when a v7 writer staged them
+    grav = any(sh.get("grav_rows_mean") is not None for sh in s["shards"])
     rows = []
     for sh in s["shards"]:
-        rows.append((
+        row = (
             sh["shard"],
             fmt(sh["particles"], "{}"),
             fmt(sh["work_mean"], "{:.4g}"),
             fmt(sh.get("work_share"), "{:.1%}"),
             fmt(sh["rows_mean"], "{:.4g}"),
             fmt(sh["occ_p95"], "{:.2f}"),
-        ))
-    lines.append(render_table(
-        rows, headers=("shard", "particles", "work", "share", "halo rows",
-                       "occ p95")))
+        )
+        if grav:
+            row += (fmt(sh.get("grav_rows_mean"), "{:.4g}"),
+                    fmt(sh.get("grav_occ_p95"), "{:.2f}"))
+        rows.append(row)
+    headers = ("shard", "particles", "work", "share", "halo rows",
+               "occ p95")
+    if grav:
+        headers += ("grav rows", "grav occ")
+    lines.append(render_table(rows, headers=headers))
     info = [
         ("windows recorded", s["windows"]),
         ("exchange mode", s.get("mode") or "-"),
@@ -676,6 +707,15 @@ def render_shards(s: Dict) -> str:
         ("escape trips", s.get("trips", 0)),
         ("imbalance events", s.get("imbalance_events", 0)),
     ]
+    g = s.get("gravity")
+    if g:
+        info += [
+            ("gravity mode", g.get("mode") or "-"),
+            ("gravity rows/serve", g.get("shipped_rows") or "-"),
+            ("gravity bytes/step", _fmt_bytes(g.get("bytes_per_step"))
+             if g.get("bytes_per_step") else "-"),
+            ("gravity trips", g.get("trips", 0)),
+        ]
     if s.get("work_ratio_p95") is not None:
         info.append(("work max/mean p95", f"{s['work_ratio_p95']:.3f}"))
     lines.append(render_table(info))

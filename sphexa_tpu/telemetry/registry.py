@@ -25,13 +25,16 @@ import numpy as np
 #: the physics-observability kinds (physics / numerics / drift /
 #: field_health), v4 the time-and-history kinds (phase_attr / crash),
 #: v5 the autotuning kinds (sweep / tuning), v6 the block-timestep kind
-#: (dt_bins); none changed the older kinds, so v6 readers accept v1-v5
-#: files.
-SCHEMA_VERSION = 6
+#: (dt_bins); v7 the optional ``stage`` payload ("sph" | "gravity") on
+#: the exchange / shard_load kinds — the gravity near field's MAC-sized
+#: sparse serve emits its own exchange record next to the SPH one. No
+#: new kinds and no new REQUIRED fields, so v7 readers accept v1-v6
+#: files and v6 readers skip the extra key.
+SCHEMA_VERSION = 7
 
 #: event schema versions this reader understands (older versions only
 #: ever ADD kinds, so the per-kind field table below covers them all)
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 #: every event kind the schema admits, with its required payload fields
 #: (beyond the envelope ``v``/``seq``/``t``/``kind``). The CLI's --strict

@@ -92,6 +92,16 @@ KNOBS: Dict[str, KnobSpec] = {
                  (1, 4, 8), COST_STATIC,
                  "deferred resort/verify window: steps launched between "
                  "batched diagnostic fetches (the resort cadence)"),
+        KnobSpec("grav_window", "Simulation", "grav_window",
+                 (256, 0, 128, 512, 1024), COST_RECONFIGURE,
+                 "pad quantum (rows) for the MAC-sized sparse gravity "
+                 "near-field exchange; 0 = ship full peer slabs (the "
+                 "pre-sizing lowering, byte-identical)"),
+        KnobSpec("grav_window_margin", "Simulation", "grav_window_margin",
+                 (1.4, 1.2, 1.7, 2.0), COST_RECONFIGURE,
+                 "headroom over the measured MAC-need rows per gravity "
+                 "halo cap (escape sentinel trips regrow it; larger = "
+                 "fewer trips, more comm volume)"),
         # -- hierarchical block time steps (sph/blockdt.py) ---------------
         # NOTE: dt_bins changes the integration scheme, not just its
         # cost — sweep it only under a conservation-drift budget (the
@@ -136,7 +146,7 @@ GRAVITY_KNOBS = ("target_block", "blocks_per_chunk", "super_factor",
 NEIGHBOR_KNOBS = ("block", "cell_target", "run_cap", "gap", "group",
                   "list_skin_rel")
 #: knobs resolved on the Simulation constructor itself
-SIMULATION_KNOBS = ("check_every",)
+SIMULATION_KNOBS = ("check_every", "grav_window", "grav_window_margin")
 #: block-timestep knobs (also Simulation-constructor-resolved; they land
 #: on PropagatorConfig through make_propagator_config)
 BLOCKDT_KNOBS = ("dt_bins", "bin_sync_every", "bin_resort_drift")

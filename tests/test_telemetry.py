@@ -728,6 +728,41 @@ class TestCli:
         assert s["imbalance_events"] == 1 and s["trips"] == 1
         assert s["memory"][0]["peak_bytes_in_use"] == [4096, 8192]
 
+    def test_shards_view_splits_gravity_stage(self, tmp_path, capsys):
+        """Schema v7: a run with BOTH staged exchange records renders
+        the SPH columns unchanged plus the gravity serve's columns and
+        summary block; the stages never mix (the gravity rows must not
+        pollute the SPH halo-rows aggregate)."""
+        d = tmp_path / "gmesh"
+        t = Telemetry(sinks=[JsonlSink(str(d / "events.jsonl"))])
+        for it in (3, 6):
+            t.event("shard_load", it=it, steps=3, stage="sph",
+                    particles=[256, 256], work=[900.0 + it, 700.0])
+            t.event("exchange", it=it, steps=3, mode="sparse",
+                    shipped_rows=512, rows=[200 + it, 150],
+                    occ=[0.8, 0.6], bytes_per_step=512 * 18 * 4,
+                    trips=0, stage="sph")
+            t.event("exchange", it=it, steps=3, mode="sparse",
+                    shipped_rows=2864, rows=[1000 + it, 900],
+                    occ=[0.95, 0.7], bytes_per_step=2864 * 5 * 4,
+                    trips=1, stage="gravity")
+        t.close()
+        write_manifest(str(d), particles=512, mesh_shape=(2,))
+        assert cli_main(["shards", str(d)]) == 0
+        out = capsys.readouterr().out
+        assert "grav rows" in out and "grav occ" in out
+        assert "gravity rows/serve" in out and "gravity trips" in out
+        assert cli_main(["shards", str(d), "--format", "json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        # SPH aggregates untouched by the gravity records
+        assert s["shipped_rows"] == 512 and s["trips"] == 0
+        assert s["shards"][0]["rows_mean"] < 1000
+        g = s["gravity"]
+        assert g["shipped_rows"] == 2864 and g["trips"] == 1
+        assert g["windows"] == 2 and g["mode"] == "sparse"
+        assert s["shards"][0]["grav_rows_mean"] > 1000
+        assert 0 < s["shards"][1]["grav_occ_p95"] <= 1.0
+
     def test_shards_exit_1_without_shard_telemetry(self, tmp_path, capsys):
         """The mesh smoke's assertion: a run with no per-shard events
         must FAIL the shards view (exit 1), so check.sh catches a

@@ -347,7 +347,6 @@ class TestSchemaV5:
 
 class TestSchemaV6:
     def test_v6_kind_registered(self):
-        assert SCHEMA_VERSION == 6
         assert KIND_SINCE["dt_bins"] == 6
 
     def test_v6_event_validates(self):
@@ -363,6 +362,27 @@ class TestSchemaV6:
         bad = {"v": 5, "seq": 0, "t": 1.0, "kind": "dt_bins", "it": 0,
                "pop": [1], "updates": 1, "updates_full": 1}
         assert any("v6-only" in p for p in validate_event(bad))
+
+
+class TestSchemaV7:
+    def test_v7_is_current(self):
+        assert SCHEMA_VERSION == 7
+        # v7 adds the optional staged-exchange payload, no new kinds: no
+        # KIND_SINCE entry may claim 7
+        assert max(KIND_SINCE.values()) == 6
+
+    def test_v7_staged_exchange_validates(self):
+        for stage in ("sph", "gravity"):
+            ok = {"v": 7, "seq": 0, "t": 1.0, "kind": "exchange", "it": 1,
+                  "shipped_rows": 460, "rows": [460, 460],
+                  "stage": stage}
+            assert validate_event(ok) == []
+
+    def test_v6_exchange_without_stage_still_validates(self):
+        # pre-v7 writers never staged; the field stays optional
+        ok = {"v": 6, "seq": 0, "t": 1.0, "kind": "exchange", "it": 1,
+              "shipped_rows": 10, "rows": [10]}
+        assert validate_event(ok) == []
 
 
 class TestCli:
