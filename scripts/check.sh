@@ -11,6 +11,7 @@
 #   scripts/check.sh --telemetry-only
 #   scripts/check.sh --history-only
 #   scripts/check.sh --tuning-only
+#   scripts/check.sh --lowering-only
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -345,6 +346,52 @@ run_blockdt() {
     rm -rf "$dir"
 }
 
+run_lowering() {
+    echo "== jaxdiff lowering lock (fingerprint verify vs LOWERING_LOCK.json) =="
+    local tmp rc
+    # the committed lock must HOLD against the committed sources: every
+    # registry entry's canonical lowering fingerprint, verified at the
+    # same 8-virtual-device mesh the lock was written at — a silent
+    # lowering drift fails HERE before it reaches a chip round
+    env SPHEXA_AUDIT_DEVICES=8 python -m sphexa_tpu.devtools.audit \
+        lowering --cpu-devices 8
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "lowering lock verification failed (rc=$rc): an entry's"
+        echo "jaxpr drifted from LOWERING_LOCK.json. Review the"
+        echo "structural diff above; if the change is intentional:"
+        echo "  sphexa-audit lowering --write --cpu-devices 8"
+        echo "(docs/STATIC_ANALYSIS.md, jaxdiff)."
+        exit $rc
+    fi
+    # exit-code contract smoke: a doctored digest must fail with 1, an
+    # unreadable lock with 2 — the gate's teeth (same pattern as the
+    # TELEMETRY_LOCK smoke in run_history)
+    tmp=$(mktemp -d)
+    python - "$tmp" <<'EOF'
+import json, sys
+lock = json.load(open("LOWERING_LOCK.json"))
+lock["entries"]["step_std"]["digest"] = "0" * 32
+json.dump(lock, open(sys.argv[1] + "/doctored.json", "w"))
+open(sys.argv[1] + "/corrupt.json", "w").write("{not json")
+EOF
+    python -m sphexa_tpu.devtools.audit lowering --entries step_std \
+        --lock "$tmp/doctored.json" >/dev/null
+    if [ $? -ne 1 ]; then
+        echo "lowering failed to flag a doctored lock (expected exit 1)"
+        rm -rf "$tmp"
+        exit 1
+    fi
+    python -m sphexa_tpu.devtools.audit lowering --entries step_std \
+        --lock "$tmp/corrupt.json" 2>/dev/null
+    if [ $? -ne 2 ]; then
+        echo "lowering failed to reject a corrupt lock (expected exit 2)"
+        rm -rf "$tmp"
+        exit 1
+    fi
+    rm -rf "$tmp"
+}
+
 run_multichip_diff() {
     echo "== multi-chip comm-volume gate (measure_multichip --quick vs baseline) =="
     local tmp rc
@@ -423,6 +470,10 @@ case "${1:-}" in
         run_blockdt
         exit 0
         ;;
+    --lowering-only)
+        run_lowering
+        exit 0
+        ;;
 esac
 
 run_lint
@@ -433,6 +484,7 @@ run_telemetry
 run_history
 run_tuning
 run_blockdt
+run_lowering
 run_multichip_diff
 
 echo "== tier-1 tests (fast tier, CPU) =="

@@ -37,12 +37,26 @@ name-stack scopes, classified against a device model:
 - JXA303  a declared-compute-bound phase whose arithmetic intensity
           sits below the device ridge point
 
+The JXA4xx *jaxdiff* series certifies the lowering's IDENTITY
+(``lowerdiff.py``; surfaced as ``sphexa-audit lowering``): every
+entry's canonical jaxpr fingerprint is locked in the committed
+``LOWERING_LOCK.json`` — drift exits 1 with a phase-attributed
+structural diff, intentional changes re-lock with ``--write``:
+
+- JXA401  bitwise-replay hazards: float scatter accumulation with
+          neither unique nor sorted indices, reduce_precision eqns,
+          float-reduction collectives outside a proven total order
+- JXA402  a tuning knob's declared off sentinel perturbing the
+          baseline step lowering (off-vs-unset fingerprint compare for
+          every off_sentinel KnobSpec, zero per-knob test code)
+
 Usage::
 
     python -m sphexa_tpu.devtools.audit sphexa_tpu
     sphexa-audit sphexa_tpu --format json
     sphexa-audit preflight --mesh 4
     sphexa-audit cost --device v5e
+    sphexa-audit lowering --diff
     sphexa-audit --list-rules
 
 Suppress a finding with an inline comment (with a reason) on or directly

@@ -37,11 +37,13 @@ def build_parser() -> argparse.ArgumentParser:
         prog="sphexa-audit",
         description="jaxaudit: trace-level jaxpr/lowering auditor "
                     "(rules JXA101-JXA106, SPMD shardcheck "
-                    "JXA201-JXA204, cost rules JXA301-JXA303) over the "
+                    "JXA201-JXA204, cost rules JXA301-JXA303, "
+                    "determinism/knob-inertness JXA401-JXA402) over the "
                     "registered hot entry points. 'sphexa-audit "
                     "preflight --help' for the campaign preflight mode, "
                     "'sphexa-audit cost --help' for the static roofline "
-                    "cost gate.",
+                    "cost gate, 'sphexa-audit lowering --help' for the "
+                    "jaxdiff lowering-fingerprint lock.",
     )
     ap.add_argument("targets", nargs="*", default=[_DEFAULT_TARGET],
                     help="registry modules: 'sphexa_tpu' (the package "
@@ -99,6 +101,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sphexa_tpu.devtools.audit.costcli import main as cost_main
 
         return cost_main(argv[1:])
+    if argv and argv[0] == "lowering":
+        from sphexa_tpu.devtools.audit.lowerdiff import main as lowering_main
+
+        return lowering_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     # heavy imports AFTER argparse so --help stays instant

@@ -155,6 +155,11 @@ class EntryCase:
     # JXA204 growth probe: rebuild the SAME entry at a larger toy N
     # (returns (grown EntryCase, n_ratio)); None = no growth probe
     grow: Optional[Callable[[], Tuple["EntryCase", float]]] = None
+    # JXA402 knob-inertness probes: a thunk returning the list of
+    # lowerdiff.KnobProbe off-vs-unset comparisons this entry vouches
+    # for (the registry's knob_inertness entry wires
+    # production_knob_probes here); None = rule does not apply
+    knob_probes: Optional[Callable[[], Any]] = None
 
 
 @dataclasses.dataclass

@@ -638,3 +638,22 @@ def tree_build_sizing():
         return occ, ext, hist
 
     return EntryCase(fn=fn, args=(state.x, state.y, state.z, box, keys))
+
+
+@entrypoint("knob_inertness", phase_coverage_min=0.0)
+def knob_inertness():
+    """JXA402 carrier: the traced fn is a stub (the rule's real work is
+    the off-vs-unset probe pairs built by production_knob_probes, which
+    fingerprint probe Simulations for every off-sentinel KnobSpec in
+    tuning/knobs.py). A dedicated entry keeps the probes out of every
+    step entry's rule loop while still running in every package audit.
+    """
+    import jax.numpy as jnp
+
+    from sphexa_tpu.devtools.audit.lowerdiff import production_knob_probes
+
+    return EntryCase(
+        fn=lambda x: x * 1.0,
+        args=(jnp.ones((8,), jnp.float32),),
+        knob_probes=production_knob_probes,
+    )

@@ -14,4 +14,6 @@ from sphexa_tpu.devtools.audit.rules import (  # noqa: F401
     jxa301_phase_coverage,
     jxa302_cost_budget,
     jxa303_memory_bound,
+    jxa401_nondeterminism,
+    jxa402_knob_inertness,
 )

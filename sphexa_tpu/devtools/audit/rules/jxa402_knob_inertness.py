@@ -1,0 +1,64 @@
+"""JXA402: knob-inertness meta-rule.
+
+Every tuning knob that declares an ``off_sentinel`` in
+``tuning/knobs.py`` promises: resolving the knob to that value through
+``tuned=`` leaves the step lowering fingerprint-identical to never
+mentioning the knob at all. That is the contract the hand-written
+byte-identity pins used to check one knob at a time (dt_bins=None,
+grav_window=0); this rule checks it for the WHOLE registry with zero
+per-knob test code — a new knob adds ``off_sentinel=...`` to its
+KnobSpec and is probed automatically.
+
+The probes live on ``EntryCase.knob_probes`` (the registry's
+``knob_inertness`` entry wires ``lowerdiff.production_knob_probes``,
+which first runs ``knobs.validate_off_sentinels()`` so a renamed
+resolution site fails LOUDLY rather than letting the probe pass
+vacuously). Each probe compares two canonical lowering fingerprints
+(``lowerdiff.fingerprint_callable`` over the exact launch routing,
+``sim._step_fn(donated=sim._donate_active)``), so an off-path leak shows
+up whether it adds eqns, swaps a const, or silently re-routes to a
+different step twin.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import EntryTrace, register
+from sphexa_tpu.devtools.common import Finding
+
+
+@register(
+    "JXA402", "knob-inertness",
+    "a tuning knob's declared off sentinel perturbs the baseline step "
+    "lowering — the off path leaks into the never-mentioned program",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    if trace.case.knob_probes is None:
+        return []
+    from sphexa_tpu.devtools.audit.lowerdiff import _deltas
+
+    findings: List[Finding] = []
+    for probe in trace.case.knob_probes():
+        if probe.off.digest == probe.base.digest:
+            continue
+        d = _deltas(probe.base.lock_payload(), probe.off)
+        where = (f"first divergence at eqn #{d['first_divergence']} "
+                 f"(phase {d['first_divergence_phase']})"
+                 if d["first_divergence"] is not None
+                 else "consts differ (no per-eqn divergence)")
+        findings.append(trace.finding(
+            "JXA402",
+            f"knob {probe.knob!r}: tuned={{{probe.knob}: "
+            f"{probe.off_value!r}}} does not lower identically to "
+            f"leaving the knob unset ({probe.detail}); "
+            f"eqn delta {d['eqns']:+d}, {where}"
+            + (f", phases changed: {', '.join(d['phases_changed'][:3])}"
+               if d["phases_changed"] else "")
+            + (f", phases added: {', '.join(d['phases_added'][:3])}"
+               if d["phases_added"] else "")
+            + " — the off sentinel must be indistinguishable from "
+              "absence (fix the resolution default or the sentinel "
+              "declaration in tuning/knobs.py).",
+        ))
+    return findings

@@ -202,5 +202,9 @@ def upsweep(leaf_coeffs, node_com, tree, meta, node_of_leaf, p: int):
     for s, e in reversed(meta.level_ranges[1:]):
         par = tree.parent[s:e]
         d = node_com[s:e] - node_com[par]  # child - parent
-        node_c = node_c.at[par].add(m2m(node_c[s:e], d, p))
+        # sorted parent rows (level-ordered layout), see the cartesian
+        # upsweep in traversal.py — keeps the duplicate-index
+        # accumulation order fixed for the JXA401 replay contract
+        node_c = node_c.at[par].add(m2m(node_c[s:e], d, p),
+                                    indices_are_sorted=True)
     return node_c

@@ -370,7 +370,12 @@ def _upsweep_mass_com(leaf_w, tree, meta):
     num_n = meta.num_nodes
     node_w = jnp.zeros((num_n, 4), leaf_w.dtype).at[tree.node_of_leaf].set(leaf_w)
     for s, e in reversed(meta.level_ranges[1:]):
-        node_w = node_w.at[tree.parent[s:e]].add(node_w[s:e])
+        # parent rows are non-decreasing inside a level range (children
+        # of one parent are contiguous in the level-ordered layout), so
+        # the duplicate-index accumulation has a fixed segment order —
+        # the JXA401 bitwise-replay contract depends on this hint
+        node_w = node_w.at[tree.parent[s:e]].add(node_w[s:e],
+                                                 indices_are_sorted=True)
     node_mass = node_w[:, 0]
     node_com = node_w[:, 1:4] / jnp.maximum(node_mass, 1e-30)[:, None]
     return node_mass, node_com
@@ -383,7 +388,9 @@ def _upsweep_quadrupoles(leaf_q, node_mass, node_com, tree, meta):
     for s, e in reversed(meta.level_ranges[1:]):
         par = tree.parent[s:e]
         d = node_com[par] - node_com[s:e]
-        node_q = node_q.at[par].add(mp.m2m_shift(node_q[s:e], node_mass[s:e], d))
+        # sorted parent rows, as in _upsweep_mass_com (JXA401)
+        node_q = node_q.at[par].add(mp.m2m_shift(node_q[s:e], node_mass[s:e], d),
+                                    indices_are_sorted=True)
     return node_q
 
 

@@ -277,6 +277,28 @@ def _dealias_leaves(tree):
     return jax.tree.map(fix, tree)
 
 
+#: tuned knobs the configure paths forward wholesale (rather than
+#: resolving through ``_knob`` in the constructor): neighbor-engine
+#: shape into make_propagator_config, gravity-solver shape into the
+#: gravity_tuning override
+_NBR_FORWARDED = ("cell_target", "run_cap", "gap", "group")
+_GRAV_FORWARDED = ("target_block", "blocks_per_chunk", "super_factor")
+
+#: every knob name the Simulation constructor actually consumes — the
+#: ``_knob``-resolved set plus the forwarded groups above. This is the
+#: LIVE consumption surface ``tuning.knobs.validate_off_sentinels``
+#: cross-checks the off-sentinel declarations against: rename a
+#: resolution site without updating this tuple (or vice versa) and the
+#: registry validation fails at import, instead of JXA402's inertness
+#: probe passing vacuously because ``tuned={name: ...}`` stopped
+#: reaching the lowering.
+CONSUMED_KNOBS = (
+    "block", "list_skin_rel", "m2p_cap_margin", "check_every",
+    "grav_window", "grav_window_margin", "dt_bins", "bin_sync_every",
+    "bin_resort_drift", "donate",
+) + _NBR_FORWARDED + _GRAV_FORWARDED
+
+
 class Simulation:
     """Owns state + static configs; reconfigures (recompiles) only when the
     cell grid no longer covers the interaction radius or a cell overflows
@@ -349,7 +371,12 @@ class Simulation:
                               ("grav_window_margin", grav_window_margin),
                               ("dt_bins", dt_bins),
                               ("bin_sync_every", bin_sync_every),
-                              ("bin_resort_drift", bin_resort_drift))
+                              ("bin_resort_drift", bin_resort_drift),
+                              # "auto" is donate's unset marker (the
+                              # param predates the knob registry and
+                              # keeps its legacy default)
+                              ("donate", None if donate == "auto"
+                               else donate))
             if v is not None
         }
         from sphexa_tpu.tuning.table import resolve_knobs
@@ -368,6 +395,7 @@ class Simulation:
         list_skin_rel = _knob("list_skin_rel", 0.2)
         m2p_cap_margin = _knob("m2p_cap_margin", 1.3)
         check_every = _knob("check_every", 1)
+        donate = _knob("donate", "auto")
         # MAC-sized sparse gravity near field (parallel/sizing.
         # device_gravity_halo): grav_window is the per-distance cap
         # padding quantum in rows (caps cache across retries at its
@@ -416,11 +444,9 @@ class Simulation:
         self.bdt_keeps = 0
         # reconfigure-cost knobs the configure paths consume each time
         self._nbr_knobs = {k: tuned_knobs[k]
-                           for k in ("cell_target", "run_cap", "gap",
-                                     "group") if k in tuned_knobs}
+                           for k in _NBR_FORWARDED if k in tuned_knobs}
         self._grav_knobs = {k: tuned_knobs[k]
-                            for k in ("target_block", "blocks_per_chunk",
-                                      "super_factor") if k in tuned_knobs}
+                            for k in _GRAV_FORWARDED if k in tuned_knobs}
         if tuned is not None:
             # the decision is itself telemetry: which knobs are active
             # and WHY (table entry key + its provenance, or the

@@ -27,6 +27,17 @@ COST_STATIC = "static"          # construction-time only (new Simulation)
 COST_RECONFIGURE = "reconfigure"  # applied at reconfigure granularity
 
 
+class _NoOff:
+    """Marker: the knob has no off sentinel (``None`` IS a real sentinel
+    for dt_bins, so absence needs its own type)."""
+
+    def __repr__(self):  # pragma: no cover - cosmetic
+        return "NO_OFF"
+
+
+NO_OFF = _NoOff()
+
+
 @dataclasses.dataclass(frozen=True)
 class KnobSpec:
     """One tunable: identity + owning surface + search domain + cost."""
@@ -42,6 +53,17 @@ class KnobSpec:
     #: COST_STATIC or COST_RECONFIGURE
     cost: str
     description: str = ""
+    #: the value that turns the knob's FEATURE OFF (NO_OFF = the knob
+    #: has no off state). Contract enforced by jaxaudit JXA402: setting
+    #: the knob to this value through ``tuned=`` must leave the probe
+    #: simulation's step lowering fingerprint-identical to never
+    #: mentioning the knob at all — the meta-rule that generalizes the
+    #: hand-written dt_bins=None / grav_window=0 byte-identity pins.
+    off_sentinel: object = NO_OFF
+
+    @property
+    def has_off_sentinel(self) -> bool:
+        return self.off_sentinel is not NO_OFF
 
 
 #: every registered knob, keyed by name. Domains are the measured
@@ -62,7 +84,8 @@ KNOBS: Dict[str, KnobSpec] = {
                  (0, 4, 8, 16), COST_RECONFIGURE,
                  "superblock size in blocks for the two-level "
                  "classification (0 = flat; > 0 implies the bitmask "
-                 "compaction on the pallas backend)"),
+                 "compaction on the pallas backend)",
+                 off_sentinel=0),
         KnobSpec("m2p_cap_margin", "GravityConfig", "m2p_cap_margin",
                  (1.3, 1.15, 1.5), COST_RECONFIGURE,
                  "M2P interaction-list cap margin (eval cost is linear "
@@ -91,12 +114,21 @@ KNOBS: Dict[str, KnobSpec] = {
         KnobSpec("check_every", "Simulation", "check_every",
                  (1, 4, 8), COST_STATIC,
                  "deferred resort/verify window: steps launched between "
-                 "batched diagnostic fetches (the resort cadence)"),
+                 "batched diagnostic fetches (the resort cadence)",
+                 off_sentinel=1),
         KnobSpec("grav_window", "Simulation", "grav_window",
                  (256, 0, 128, 512, 1024), COST_RECONFIGURE,
                  "pad quantum (rows) for the MAC-sized sparse gravity "
                  "near-field exchange; 0 = ship full peer slabs (the "
-                 "pre-sizing lowering, byte-identical)"),
+                 "pre-sizing lowering, byte-identical)",
+                 off_sentinel=0),
+        KnobSpec("donate", "Simulation", "donate",
+                 ("auto", True, False), COST_STATIC,
+                 "buffer donation on the single-device launch paths: "
+                 "'auto' engages the donated step twins on TPU only, "
+                 "True opts in anywhere, False pins the undonated path "
+                 "(the discard-and-replay baseline)",
+                 off_sentinel=False),
         KnobSpec("grav_window_margin", "Simulation", "grav_window_margin",
                  (1.4, 1.2, 1.7, 2.0), COST_RECONFIGURE,
                  "headroom over the measured MAC-need rows per gravity "
@@ -110,16 +142,19 @@ KNOBS: Dict[str, KnobSpec] = {
                  (2, 4, 8), COST_STATIC,
                  "power-of-two per-particle dt bins (None/absent = the "
                  "global-dt path; updates saved scale with occupancy of "
-                 "the deep bins)"),
+                 "the deep bins)",
+                 off_sentinel=None),
         KnobSpec("bin_sync_every", "PropagatorConfig", "bin_sync_every",
                  (1, 2, 4), COST_STATIC,
                  "cycles between bin reassignments at the sync substep "
-                 "(higher = fewer rebin passes, staler bins)"),
+                 "(higher = fewer rebin passes, staler bins)",
+                 off_sentinel=1),
         KnobSpec("bin_resort_drift", "PropagatorConfig",
                  "bin_resort_drift", (0.0, 0.01, 0.05), COST_STATIC,
                  "drift-aware resort threshold: keep the current order "
                  "while folded-key inversions stay under this fraction "
-                 "of n (0 = resort whenever any inversion appears)"),
+                 "of n (0 = resort whenever any inversion appears)",
+                 off_sentinel=0.0),
     )
 }
 
@@ -146,7 +181,8 @@ GRAVITY_KNOBS = ("target_block", "blocks_per_chunk", "super_factor",
 NEIGHBOR_KNOBS = ("block", "cell_target", "run_cap", "gap", "group",
                   "list_skin_rel")
 #: knobs resolved on the Simulation constructor itself
-SIMULATION_KNOBS = ("check_every", "grav_window", "grav_window_margin")
+SIMULATION_KNOBS = ("check_every", "grav_window", "grav_window_margin",
+                    "donate")
 #: block-timestep knobs (also Simulation-constructor-resolved; they land
 #: on PropagatorConfig through make_propagator_config)
 BLOCKDT_KNOBS = ("dt_bins", "bin_sync_every", "bin_resort_drift")
@@ -154,6 +190,48 @@ BLOCKDT_KNOBS = ("dt_bins", "bin_sync_every", "bin_resort_drift")
 
 def knob_names() -> Tuple[str, ...]:
     return tuple(KNOBS)
+
+
+def off_sentinel_knobs() -> Tuple[KnobSpec, ...]:
+    """The specs carrying an off sentinel, in registry order — the
+    population jaxaudit's JXA402 knob-inertness meta-rule probes."""
+    return tuple(s for s in KNOBS.values() if s.has_off_sentinel)
+
+
+def validate_off_sentinels() -> None:
+    """Check every off-sentinel declaration against the LIVE Simulation
+    consumption surface (``simulation.CONSUMED_KNOBS``); raises
+    ``RuntimeError`` naming each drifted knob.
+
+    The failure mode this closes: rename a knob's resolution site in the
+    Simulation constructor and ``tuned={name: off}`` silently stops
+    reaching the lowering — JXA402's off-vs-unset probe then passes
+    VACUOUSLY forever. Called from ``validate_registry()`` (so
+    ``import sphexa_tpu.tuning`` fails loudly) and again by the JXA402
+    probe builder before it trusts a probe result."""
+    import importlib
+
+    sim_mod = importlib.import_module("sphexa_tpu.simulation")
+    consumed = set(getattr(sim_mod, "CONSUMED_KNOBS", ()))
+    problems = []
+    for spec in off_sentinel_knobs():
+        if spec.name not in consumed:
+            problems.append(
+                f"{spec.name}: off_sentinel={spec.off_sentinel!r} declared "
+                f"but the name is not in simulation.CONSUMED_KNOBS — the "
+                f"constructor no longer resolves it, so the JXA402 "
+                f"inertness probe would pass vacuously (re-wire the "
+                f"resolution site or drop the sentinel)")
+        if spec.off_sentinel is not None and spec.domain \
+                and type(spec.off_sentinel) not in {type(d) for d in
+                                                    spec.domain} | {bool}:
+            problems.append(
+                f"{spec.name}: off_sentinel {spec.off_sentinel!r} type "
+                f"does not match the domain {spec.domain!r}")
+    if problems:
+        raise RuntimeError(
+            "off-sentinel knob declarations drifted from the live "
+            "Simulation consumption surface:\n  " + "\n  ".join(problems))
 
 
 def validate_registry() -> None:
@@ -189,3 +267,4 @@ def validate_registry() -> None:
         raise RuntimeError(
             "tuning knob registry drifted from the live configs:\n  "
             + "\n  ".join(problems))
+    validate_off_sentinels()

@@ -1,0 +1,40 @@
+"""JXA401 fixture: unordered float scatter accumulation.
+
+The firing entry accumulates float updates at DUPLICATE indices with
+neither ``unique_indices`` nor ``indices_are_sorted`` declared — XLA may
+combine the colliding adds in any order, and float addition does not
+commute in rounding, so two runs of the same program need not agree
+bitwise. The honest twin performs the same accumulation but declares
+``indices_are_sorted=True`` (its index vector IS non-decreasing — the
+gravity-upsweep pattern from gravity/traversal.py, where the
+level-ordered layout fixes the segment order).
+"""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+
+@entrypoint("unordered_scatter_add", phase_coverage_min=0.0)  # expect: JXA401
+def unordered_scatter_add():
+    # duplicate indices on purpose: rows 0 and 2 each collide
+    idx = jnp.array([0, 0, 2, 2], dtype=jnp.int32)
+
+    def fn(acc, upd):
+        return acc.at[idx].add(upd)
+
+    return EntryCase(
+        fn=fn, args=(jnp.zeros(4, jnp.float32), jnp.ones(4, jnp.float32)))
+
+
+@entrypoint("sorted_scatter_add", phase_coverage_min=0.0)
+def sorted_scatter_add():
+    # the SAME colliding accumulation, replay-safe: the index vector is
+    # non-decreasing and says so, fixing the combine order
+    idx = jnp.array([0, 0, 2, 2], dtype=jnp.int32)
+
+    def fn(acc, upd):
+        return acc.at[idx].add(upd, indices_are_sorted=True)
+
+    return EntryCase(
+        fn=fn, args=(jnp.zeros(4, jnp.float32), jnp.ones(4, jnp.float32)))

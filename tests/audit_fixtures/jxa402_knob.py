@@ -1,0 +1,65 @@
+"""JXA402 fixture: a knob whose declared off value leaks into the
+lowering.
+
+The probes are manufactured directly (``lowerdiff.KnobProbe`` over
+``fingerprint_callable``) so the fixture exercises the RULE — compare
+off vs unset fingerprints, fire on digest drift — without building a
+Simulation. The production probe builder
+(``lowerdiff.production_knob_probes``) is pinned separately by
+tests/test_lowerdiff.py over the real tuning/knobs.py registry.
+
+The firing entry's "off" program carries one extra eqn (the classic
+leak: an off-path guard that still lowers a select); the honest twin's
+off program is eqn-for-eqn the baseline.
+"""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+from sphexa_tpu.devtools.audit.lowerdiff import (
+    KnobProbe,
+    fingerprint_callable,
+)
+
+_X = jnp.ones((8,), jnp.float32)
+
+
+def _base(x):
+    return x * 2.0
+
+
+def _leaky_off(x):
+    # the off path leaves a residue: one extra eqn vs never mentioning
+    # the knob (a real leak looks like a dead select or an extra
+    # convert the "disabled" branch still lowers)
+    return x * 2.0 + 0.0
+
+
+def _leaky_probes():
+    return [KnobProbe(
+        knob="leaky_gate", off_value=0,
+        base=fingerprint_callable(_base, _X),
+        off=fingerprint_callable(_leaky_off, _X),
+        detail="fixture leaky_gate: off lowers one extra eqn",
+    )]
+
+
+def _inert_probes():
+    return [KnobProbe(
+        knob="inert_gate", off_value=0,
+        base=fingerprint_callable(_base, _X),
+        off=fingerprint_callable(_base, _X),
+        detail="fixture inert_gate: off is indistinguishable from unset",
+    )]
+
+
+@entrypoint("leaky_off_knob", phase_coverage_min=0.0)  # expect: JXA402
+def leaky_off_knob():
+    return EntryCase(fn=lambda x: x * 1.0, args=(_X,),
+                     knob_probes=_leaky_probes)
+
+
+@entrypoint("inert_off_knob", phase_coverage_min=0.0)
+def inert_off_knob():
+    return EntryCase(fn=lambda x: x * 1.0, args=(_X,),
+                     knob_probes=_inert_probes)

@@ -36,7 +36,8 @@ _EXPECT_RE = re.compile(
 
 ALL_RULE_IDS = ["JXA101", "JXA102", "JXA103", "JXA104", "JXA105", "JXA106",
                 "JXA201", "JXA202", "JXA203", "JXA204",
-                "JXA301", "JXA302", "JXA303"]
+                "JXA301", "JXA302", "JXA303",
+                "JXA401", "JXA402"]
 
 
 def expected_findings(path: Path):

@@ -97,16 +97,26 @@ class TestBitwisePin:
 
     def test_dt_bins_none_lowering_untouched(self):
         # the opt-out guard: a default config must lower without ANY
-        # block-timestep scope — dt_bins=None leaves the global path's
-        # HLO byte-identical, which this scope scan pins cheaply
+        # block-timestep scope. Pinned on the jaxdiff canonical
+        # fingerprint (the shared helper the LOWERING_LOCK and the
+        # JXA402 knob probes use) instead of an ad-hoc HLO text scan:
+        # the phase table must have no dt-bins scope and no canonical
+        # eqn may reference a bdt_ helper
         from sphexa_tpu import propagator as prop
+        from sphexa_tpu.devtools.audit.lowerdiff import fingerprint_callable
 
         state, box, const = init_sedov(6)
         cfg = make_propagator_config(state, box, const, block=512)
         assert cfg.dt_bins is None
-        txt = prop.step_hydro_std.lower(state, box, cfg, None).as_text()
-        assert "dt-bins" not in txt
-        assert "bdt_" not in txt
+        fp = fingerprint_callable(
+            lambda s, b: prop.step_hydro_std(s, b, cfg, None), state, box)
+        assert not any("dt-bins" in ph for ph in fp.phases)
+        assert not any("bdt_" in ln for ln in fp.lines)
+        # and the fingerprint is reproducible within a process — the
+        # property the committed LOWERING_LOCK.json relies on
+        fp2 = fingerprint_callable(
+            lambda s, b: prop.step_hydro_std(s, b, cfg, None), state, box)
+        assert fp2.digest == fp.digest
 
 
 class TestTwoScaleProxy:

@@ -831,7 +831,15 @@ class TestGravityMacWindows:
         """The grav_window=0 contract: an empty grav_cells lowers the
         sharded step to byte-identical StableHLO as a config that never
         saw the sizing pass (win stays the int S full-slab window), while
-        a sparse cap tuple genuinely changes the program."""
+        a sparse cap tuple genuinely changes the program.
+
+        The raw ``as_text()`` comparison here is THE canonicalizer
+        guard: every other lowering-identity pin in the repo (this
+        class included, below) goes through the jaxdiff fingerprint,
+        and this one byte-level assert is what proves the fingerprint
+        is not hashing away a real difference.
+        """
+        from sphexa_tpu.devtools.audit.lowerdiff import fingerprint_callable
         from sphexa_tpu.propagator import step_hydro_ve
 
         state, sim = self._evrard_sim("ve")
@@ -845,10 +853,18 @@ class TestGravityMacWindows:
         text_base = lower(base)
         text_zero = lower(zero)
         assert text_base == text_zero
+        # the fingerprint helper must agree with the byte-level verdict
+        # in both directions: identical programs collide, a genuinely
+        # different program (sparse caps) does not
+        fprint = lambda st: fingerprint_callable(
+            st._jitted, sstate, sim.box, sim._gtree, None)
+        fp_base = fprint(base)
+        assert fprint(zero).digest == fp_base.digest
         cells = self._mac_cells(state, sim, 4)
         sparse = make_sharded_step(mesh, sim._cfg, step_fn=step_hydro_ve,
                                    grav_cells=cells)
         assert lower(sparse) != text_base
+        assert fprint(sparse).digest != fp_base.digest
 
 
 @pytest.mark.slow
