@@ -12,6 +12,7 @@
 #   scripts/check.sh --history-only
 #   scripts/check.sh --tuning-only
 #   scripts/check.sh --lowering-only
+#   scripts/check.sh --schema-only
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
@@ -392,6 +393,54 @@ EOF
     rm -rf "$tmp"
 }
 
+run_schema() {
+    echo "== statecheck schema lock (symbolic state schema vs STATE_SCHEMA.json) =="
+    local tmp rc
+    # the committed lock must HOLD against the committed sources: every
+    # registry entry's carry/output schema (pytree paths, dtype,
+    # weak_type, axis polynomials in N), verified cross-process at the
+    # default mesh the lock was written at — a carry change that would
+    # break the ensemble server or the restart format fails HERE
+    python -m sphexa_tpu.devtools.audit schema
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "state schema verification failed (rc=$rc): an entry's"
+        echo "carry/output schema drifted from STATE_SCHEMA.json, or a"
+        echo "carry is not closed (JXA503). Review the per-leaf diff"
+        echo "above; if the change is intentional:"
+        echo "  sphexa-audit schema --write"
+        echo "(docs/STATIC_ANALYSIS.md, statecheck)."
+        exit $rc
+    fi
+    # exit-code contract smoke: a doctored leaf dtype must fail with 1,
+    # an unreadable lock with 2 — the gate's teeth (same pattern as the
+    # TELEMETRY_LOCK and LOWERING_LOCK smokes)
+    tmp=$(mktemp -d)
+    python - "$tmp" <<'EOF'
+import json, sys
+lock = json.load(open("STATE_SCHEMA.json"))
+for leaf in lock["entries"]["step_std"]["leaves"].values():
+    leaf["dtype"] = "float64"
+json.dump(lock, open(sys.argv[1] + "/doctored.json", "w"))
+open(sys.argv[1] + "/corrupt.json", "w").write("{not json")
+EOF
+    python -m sphexa_tpu.devtools.audit schema --entries step_std \
+        --lock "$tmp/doctored.json" >/dev/null
+    if [ $? -ne 1 ]; then
+        echo "schema failed to flag a doctored lock (expected exit 1)"
+        rm -rf "$tmp"
+        exit 1
+    fi
+    python -m sphexa_tpu.devtools.audit schema --entries step_std \
+        --lock "$tmp/corrupt.json" 2>/dev/null
+    if [ $? -ne 2 ]; then
+        echo "schema failed to reject a corrupt lock (expected exit 2)"
+        rm -rf "$tmp"
+        exit 1
+    fi
+    rm -rf "$tmp"
+}
+
 run_multichip_diff() {
     echo "== multi-chip comm-volume gate (measure_multichip --quick vs baseline) =="
     local tmp rc
@@ -474,6 +523,10 @@ case "${1:-}" in
         run_lowering
         exit 0
         ;;
+    --schema-only)
+        run_schema
+        exit 0
+        ;;
 esac
 
 run_lint
@@ -485,6 +538,7 @@ run_history
 run_tuning
 run_blockdt
 run_lowering
+run_schema
 run_multichip_diff
 
 echo "== tier-1 tests (fast tier, CPU) =="

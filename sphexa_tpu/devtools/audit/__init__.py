@@ -50,6 +50,23 @@ structural diff, intentional changes re-lock with ``--write``:
           baseline step lowering (off-vs-unset fingerprint compare for
           every off_sentinel KnobSpec, zero per-knob test code)
 
+The JXA5xx *statecheck* series certifies the carry/output SCHEMA
+(``statecheck.py``; surfaced as ``sphexa-audit schema``): each entry's
+output pytree — paths, dtype, weak_type, every axis a polynomial in N
+fitted from the two-point grow probe — is locked in the committed
+``STATE_SCHEMA.json``, and the unified ``state.SimState`` carry the
+ensemble mode (ROADMAP item 3) steps over is audited for closure and
+batchability:
+
+- JXA501  carry/output schema drift vs the committed lock (per-leaf
+          structural diff; intentional changes re-lock with --write)
+- JXA502  vmap-batchability over a member axis (trace failure,
+          per-member host callbacks, serialized loop fallback) —
+          the ensemble mode's static admission check (--vmap)
+- JXA503  carry not closed under the step: treedef or leaf-aval drift
+          between step-1 and step-2 carries (None<->array aux-slot
+          flips; JXA102 lifted to the full carry structure)
+
 Usage::
 
     python -m sphexa_tpu.devtools.audit sphexa_tpu
@@ -57,6 +74,7 @@ Usage::
     sphexa-audit preflight --mesh 4
     sphexa-audit cost --device v5e
     sphexa-audit lowering --diff
+    sphexa-audit schema --vmap
     sphexa-audit --list-rules
 
 Suppress a finding with an inline comment (with a reason) on or directly

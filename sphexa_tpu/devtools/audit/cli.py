@@ -38,12 +38,15 @@ def build_parser() -> argparse.ArgumentParser:
         description="jaxaudit: trace-level jaxpr/lowering auditor "
                     "(rules JXA101-JXA106, SPMD shardcheck "
                     "JXA201-JXA204, cost rules JXA301-JXA303, "
-                    "determinism/knob-inertness JXA401-JXA402) over the "
+                    "determinism/knob-inertness JXA401-JXA402, "
+                    "statecheck JXA501-JXA503) over the "
                     "registered hot entry points. 'sphexa-audit "
                     "preflight --help' for the campaign preflight mode, "
                     "'sphexa-audit cost --help' for the static roofline "
                     "cost gate, 'sphexa-audit lowering --help' for the "
-                    "jaxdiff lowering-fingerprint lock.",
+                    "jaxdiff lowering-fingerprint lock, 'sphexa-audit "
+                    "schema --help' for the statecheck state-schema "
+                    "lock and vmap-batchability report.",
     )
     ap.add_argument("targets", nargs="*", default=[_DEFAULT_TARGET],
                     help="registry modules: 'sphexa_tpu' (the package "
@@ -105,6 +108,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sphexa_tpu.devtools.audit.lowerdiff import main as lowering_main
 
         return lowering_main(argv[1:])
+    if argv and argv[0] == "schema":
+        from sphexa_tpu.devtools.audit.statecheck import main as schema_main
+
+        return schema_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     # heavy imports AFTER argparse so --help stays instant

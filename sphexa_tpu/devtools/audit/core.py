@@ -101,6 +101,14 @@ class AuditContext:
     # JXA204: growth-probe slack over linear-in-N for the exempt
     # (non-slab) buffer class
     tree_growth_slack: float = 1.25
+    # --- statecheck (JXA5xx) knobs ---------------------------------------
+    # JXA501 default schema lock (repo-root committed, like the cost
+    # budget); a missing DEFAULT file skips the gate (out-of-repo use)
+    state_schema_path: str = "STATE_SCHEMA.json"
+    # JXA502 member-axis width for the vmap-batchability probe; 0
+    # disables the probe (the package audit/tier-1 default — the vmap
+    # report is the `sphexa-audit schema --vmap` gate's job)
+    vmap_members: int = 0
 
 
 _CONTEXT = AuditContext()
@@ -320,6 +328,7 @@ class EntryTrace:
         self.entry = entry
         self.case = case
         self._closed = None
+        self._out_shape = None
         self._lowered = None
         self._out = dataclasses.MISSING
 
@@ -338,8 +347,21 @@ class EntryTrace:
             import jax
 
             with self._x64_scope():
-                self._closed = jax.make_jaxpr(self.case.fn)(*self.case.args)
+                # return_shape=True: the SAME trace also yields the
+                # output pytree of ShapeDtypeStructs, so statecheck's
+                # schema inference costs no extra trace
+                self._closed, self._out_shape = jax.make_jaxpr(
+                    self.case.fn, return_shape=True)(*self.case.args)
         return self._closed
+
+    @property
+    def out_shape(self):
+        """Output pytree of ShapeDtypeStructs (same trace as the jaxpr);
+        ``closed_jaxpr.out_avals`` carries the matching flat-order
+        weak_type bits."""
+        if self._out_shape is None:
+            self.closed_jaxpr  # noqa: B018 - fills the cache
+        return self._out_shape
 
     @property
     def lowered(self):

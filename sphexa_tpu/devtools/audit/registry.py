@@ -564,6 +564,9 @@ def step_std_blockdt_sharded():
 # ---------------------------------------------------------------------------
 
 
+# jaxaudit: disable=JXA502 -- the ledger's optimization_barrier (pinned
+# summation-order fence, JXA401) has no vmap batching rule in this jax;
+# ensembles reduce observables per member OUTSIDE the batched step
 @entrypoint("observable_ledger")
 def observable_ledger():
     import jax.numpy as jnp

@@ -16,4 +16,7 @@ from sphexa_tpu.devtools.audit.rules import (  # noqa: F401
     jxa303_memory_bound,
     jxa401_nondeterminism,
     jxa402_knob_inertness,
+    jxa501_schema_drift,
+    jxa502_vmap,
+    jxa503_carry_closure,
 )

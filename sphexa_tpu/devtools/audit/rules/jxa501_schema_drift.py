@@ -1,0 +1,74 @@
+"""JXA501: state-schema drift vs the committed STATE_SCHEMA.json.
+
+The symbolic carry/output schema of every entry — pytree paths, dtype,
+weak_type, each axis a polynomial in N (statecheck.entry_schema) — is a
+public contract: the ensemble server allocates member slots from it, the
+telemetry schema rows mirror it, and the restart format round-trips it.
+This rule pins the live schema against the committed lock so a carry
+change (a new diagnostics key, an f32 leaf silently widening, a
+capacity-padded axis becoming extensive) lands as a reviewed lock diff
+in the same PR, never as a silent downstream break.
+
+Skips quietly when the default lock file is absent (the JXA302 budget
+pattern: fixtures and fresh checkouts are not findings); a CORRUPT lock
+is a finding — an unreadable contract gates as loudly as a broken one.
+Rows recorded at a different mesh size are skipped: sharded shapes
+legitimately depend on P, and the lock is committed at the default
+mesh. Entries missing from the lock are the CLI's business (`--write`
+to relock), not a rule finding — existing fixtures stay clean.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import (
+    EntryTrace,
+    audit_context,
+    register,
+)
+from sphexa_tpu.devtools.common import Finding
+
+
+@register(
+    "JXA501", "state-schema-drift",
+    "entry carry/output schema (pytree paths, dtype, weak_type, axis "
+    "polynomials in N) drifted from the committed STATE_SCHEMA.json",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    from pathlib import Path
+
+    from sphexa_tpu.devtools.audit import statecheck
+
+    ctx = audit_context()
+    path = ctx.state_schema_path
+    if not Path(path).exists():
+        # no committed schema to gate against (fixture runs, fresh
+        # checkouts) — same silent skip as the JXA302 budget file
+        return []
+    try:
+        locked = statecheck.load_lock(path)
+    except statecheck.LockError as e:
+        return [trace.finding(
+            "JXA501",
+            f"schema lock unreadable: {e} — fix or regenerate with "
+            f"`sphexa-audit schema --write`.",
+        )]
+    row = locked.get(trace.entry.name)
+    if row is None:
+        # unlocked entries are surfaced by the CLI verify (missing /
+        # stale accounting), not per-entry findings
+        return []
+    current = statecheck.entry_schema(trace)
+    if row.get("mesh") != current.get("mesh"):
+        # locked at another mesh size: sharded shapes depend on P
+        return []
+    if row == current:
+        return []
+    diff = statecheck.schema_diff(trace.entry.name, row, current)
+    return [trace.finding(
+        "JXA501",
+        "; ".join(line.strip() for line in diff[1:])
+        + " — review the change and relock with "
+          "`sphexa-audit schema --write`.",
+    )]

@@ -1,0 +1,83 @@
+"""JXA502: vmap-batchability audit (the ensemble-mode admission check).
+
+ROADMAP item 3 serves ensembles by vmapping the step over a member
+axis. Whether an entry CAN be vmapped — and whether the batched program
+is still one fused device program rather than a serialized fallback —
+is decidable at trace time, so the ensemble mode's admission check is
+static: each single-device entry is traced under ``jax.vmap`` over a
+synthetic leading member axis (abstract args; no member batch is ever
+materialized) and everything that breaks or degrades batching is a
+finding, not a crash:
+
+- **trace failure**: the vmapped trace raises (a primitive with no
+  batching rule, shape logic keyed on concrete leading dims). Captured
+  and reported with the exception.
+- **host callbacks**: callback/infeed/outfeed primitives in the vmapped
+  body (the JXA104 deny family). Under vmap these serialize per member
+  — N members pay N host round trips per step.
+- **serialized fallback**: more while/scan equations in the vmapped
+  jaxpr than in the base jaxpr. vmap with no batching rule for a loop
+  construct unrolls members into a sequential scan — the batch runs
+  members one after another on one device, which is exactly what the
+  ensemble mode exists to avoid.
+
+Off by default (``vmap_members=0`` in the AuditContext keeps the extra
+trace out of the package-audit tier-1 path); ``sphexa-audit schema
+--vmap`` enables it. Sharded entries are out of scope — members
+multiply the DEVICE mesh there, not a vmap axis. A legitimately
+non-batchable entry carries an explicit inline waiver
+(``# jaxaudit: disable=JXA502 -- reason``) at its registration site.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import (
+    EntryTrace,
+    audit_context,
+    register,
+)
+from sphexa_tpu.devtools.common import Finding
+
+
+@register(
+    "JXA502", "vmap-batchability",
+    "entry fails or degrades under jax.vmap over a member axis "
+    "(trace failure, per-member host callbacks, serialized loop "
+    "fallback) — not admissible to the ensemble mode",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    from sphexa_tpu.devtools.audit import statecheck
+
+    ctx = audit_context()
+    members = ctx.vmap_members
+    if members <= 0 or trace.entry.mesh_axes:
+        return []
+    report = statecheck.vmap_probe(trace, members)
+    out: List[Finding] = []
+    if report["error"] is not None:
+        out.append(trace.finding(
+            "JXA502",
+            f"does not trace under jax.vmap over {members} members: "
+            f"{report['error']} — the entry cannot serve ensembles; "
+            f"fix the batching break or waive with a reason.",
+        ))
+        return out
+    for name, n in report["callbacks"]:
+        out.append(trace.finding(
+            "JXA502",
+            f"`{name}` x{n} in the vmapped body — host callbacks "
+            f"serialize per member ({members} members = {members}x host "
+            f"round trips per step). Hoist it to the driver or gate it "
+            f"off the ensemble path.",
+        ))
+    if report["vmap_loops"] > report["base_loops"]:
+        out.append(trace.finding(
+            "JXA502",
+            f"vmap falls back to serialized loops: "
+            f"{report['vmap_loops']} while/scan eqns batched vs "
+            f"{report['base_loops']} unbatched — members run "
+            f"sequentially instead of as one batched program.",
+        ))
+    return out

@@ -1,0 +1,106 @@
+"""JXA503: carry closure — step outputs must BE step inputs.
+
+``jax.lax.scan``, the ensemble server's member loop, and the driver's
+``step_sim_state`` all demand the same invariant: the carry pytree a
+step returns is aval- and structure-identical to the one it consumed.
+JXA102 checks the flattened leaf *signature* (its target is the silent
+step-2 retrace); this rule lifts the check to the full carry
+STRUCTURE, where the classic break is invisible to a flat zip: a
+``None`` aux slot on step 1 becoming an array on step 2 (or vice
+versa) changes the treedef itself — ``scan`` rejects it outright, and
+under the unified SimState carry it means a propagator family wrote
+into a slot it does not own.
+
+Two layers, structural first:
+
+- **treedef**: flatten both carries with paths; report leaves that
+  exist on only one side (path-anchored, None<->array flips called out
+  by name) — a structural break makes the per-leaf zip meaningless, so
+  it short-circuits.
+- **per-leaf avals**: shape, dtype, weak_type via shaped_abstractify —
+  the JXA102 carry check re-anchored to closure (the two co-fire on a
+  dtype-drifting carry; JXA102 says "this retraces", this rule says
+  "this is not a scan carry").
+
+Runs on every entry that declares a ``carry`` — all five propagator
+families, including the blockdt/turb/chem aux carries.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sphexa_tpu.devtools.audit.core import EntryTrace, register
+from sphexa_tpu.devtools.common import Finding
+
+
+def _paths(tree):
+    """{keystr path: leaf} with paths for structural anchoring."""
+    import jax
+
+    return {
+        jax.tree_util.keystr(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
+@register(
+    "JXA503", "carry-closure",
+    "step-2 carry differs from step-1 carry in treedef or leaf avals "
+    "(None<->array flips, shape/dtype/weak_type drift) — not a valid "
+    "scan/ensemble carry",
+)
+def check(trace: EntryTrace) -> List[Finding]:
+    case = trace.case
+    if case.carry is None:
+        return []
+    import jax
+    from jax.api_util import shaped_abstractify
+
+    args2 = case.carry(case.args, trace.out)
+    td1 = jax.tree_util.tree_structure(case.args)
+    td2 = jax.tree_util.tree_structure(args2)
+    if td1 != td2:
+        p1, p2 = _paths(case.args), _paths(args2)
+        dropped = sorted(set(p1) - set(p2))
+        grown = sorted(set(p2) - set(p1))
+        bits = []
+        if dropped:
+            bits.append("leaves only in step-1 args: "
+                        + ", ".join(dropped[:6])
+                        + (" ..." if len(dropped) > 6 else ""))
+        if grown:
+            bits.append("leaves only in step-2 args: "
+                        + ", ".join(grown[:6])
+                        + (" ..." if len(grown) > 6 else ""))
+        if not bits:
+            # same leaf paths, different treedef: a None slot flipped
+            # to/from a leaf-bearing subtree or a container type changed
+            bits.append(f"treedefs differ with identical leaf paths "
+                        f"({td1} vs {td2})")
+        return [trace.finding(
+            "JXA503",
+            "carry is not closed — the step changes its own carry "
+            "STRUCTURE: " + "; ".join(bits) + ". A None<->array flip in "
+            "an aux slot means this propagator family writes a slot it "
+            "does not own; scan/ensemble loops reject the carry "
+            "outright.",
+        )]
+    leaves1 = jax.tree_util.tree_flatten_with_path(case.args)[0]
+    leaves2 = jax.tree_util.tree_leaves(args2)
+    out: List[Finding] = []
+    drifted = [
+        (jax.tree_util.keystr(path), str(shaped_abstractify(l1)),
+         str(shaped_abstractify(l2)))
+        for (path, l1), l2 in zip(leaves1, leaves2)
+        if str(shaped_abstractify(l1)) != str(shaped_abstractify(l2))
+    ]
+    for path, a1, a2 in drifted[:8]:
+        out.append(trace.finding(
+            "JXA503",
+            f"carry leaf {path or '<root>'} is not closed under the "
+            f"step: {a1} in, {a2} out — scan/ensemble loops reject the "
+            f"carry; commit the leaf to its policy aval where the state "
+            f"is built.",
+        ))
+    return out

@@ -7,4 +7,5 @@ from sphexa_tpu.devtools.lint.rules import (  # noqa: F401
     jxl004_pallas_tiles,
     jxl005_static_args,
     jxl006_collectives,
+    jxl007_pytree_registration,
 )

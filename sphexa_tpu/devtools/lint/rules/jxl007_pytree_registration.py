@@ -1,0 +1,174 @@
+"""JXL007: pytree-registration hygiene for ``register_dataclass``.
+
+``jax.tree_util.register_dataclass`` flattens EVERY undeclared field as
+a traced child. A config-shaped field (str / bool / tuple / dict /
+``*Config``) silently becomes a leaf: the tracer either dies
+flatten-time on the non-array, or — worse — bakes the value in as a
+weak-typed scalar leaf and every new instance retraces. The repo's
+idiom is explicit: static metadata is declared per field
+(``dataclasses.field(metadata=dict(static=True))`` — sfc/box.py's
+``boundaries``) or per class (``meta_fields=`` on the decorator call).
+This rule makes the declaration non-optional:
+
+- a field whose ANNOTATION is static-shaped (str, bool, bytes,
+  tuple/dict/set family, type, Callable, or a ``*Config``/``*Spec``
+  class name) but is not declared static — the silent-leaf trap;
+- a DECLARED-static field annotated with an unhashable container
+  (list/dict/set): static fields are jit cache keys, the first traced
+  call raises TypeError;
+- a mutable literal default (list/dict/set displays or comprehensions,
+  bare or as ``field(default=...)``): one shared instance across every
+  constructed state is an aliasing hazard on top of dataclasses' own
+  (bypassed-by-field) guard.
+
+Purely structural — the AST pass never imports jax, so a registration
+bug cannot crash the linter that reports it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from sphexa_tpu.devtools.lint.core import Finding, ModuleInfo, register
+
+_REGISTER = "jax.tree_util.register_dataclass"
+_CONFIG_NAME = re.compile(r"(Config|Spec)$")
+_STATIC_HEADS = {
+    "str", "bool", "bytes", "type", "Type", "Callable",
+    "tuple", "Tuple", "dict", "Dict", "list", "List",
+    "set", "Set", "frozenset", "FrozenSet",
+}
+_UNHASHABLE_HEADS = {"list", "List", "dict", "Dict", "set", "Set"}
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+
+def _ann_head(ann: ast.AST) -> Optional[str]:
+    """Outermost type name of an annotation, unwrapping Optional[...]
+    (an Optional static field is still static) and string annotations."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        head = _ann_head(ann.value)
+        if head == "Optional":
+            return _ann_head(ann.slice)
+        return head
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.Name):
+        return ann.id
+    return None
+
+
+def _registered_classes(mod: ModuleInfo):
+    """(ClassDef, decorator node, decorator-declared meta field names)
+    for every register_dataclass class in the module."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for dec in node.decorator_list:
+            call = dec if isinstance(dec, ast.Call) else None
+            target = call.func if call else dec
+            if mod.qualname(target) != _REGISTER:
+                # the kwargs form rides functools.partial:
+                # @partial(register_dataclass, meta_fields=(...))
+                if not (call and mod.qualname(target) in
+                        ("functools.partial", "partial") and call.args
+                        and mod.qualname(call.args[0]) == _REGISTER):
+                    continue
+            meta: Set[str] = set()
+            if call:
+                for kw in call.keywords:
+                    if kw.arg == "meta_fields" and isinstance(
+                            kw.value, (ast.List, ast.Tuple, ast.Set)):
+                        meta |= {
+                            e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        }
+            yield node, dec, meta
+            break
+
+
+def _field_call(value: ast.AST) -> Optional[ast.Call]:
+    if isinstance(value, ast.Call):
+        name = value.func.attr if isinstance(value.func, ast.Attribute) \
+            else getattr(value.func, "id", None)
+        if name == "field":
+            return value
+    return None
+
+
+def _declares_static(call: ast.Call) -> bool:
+    """``field(metadata=dict(static=True))`` / ``{"static": True}``."""
+    for kw in call.keywords:
+        if kw.arg != "metadata":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Call):
+            return any(k.arg == "static" for k in v.keywords)
+        if isinstance(v, ast.Dict):
+            return any(isinstance(k, ast.Constant) and k.value == "static"
+                       for k in v.keys)
+    return False
+
+
+@register(
+    "JXL007",
+    "pytree-registration",
+    "register_dataclass hygiene: static-shaped fields must be DECLARED "
+    "static (field metadata or meta_fields); declared statics must be "
+    "hashable; no mutable literal defaults",
+)
+def check(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for cls, _dec, meta in _registered_classes(mod):
+        for stmt in cls.body:
+            if not isinstance(stmt, ast.AnnAssign) or \
+                    not isinstance(stmt.target, ast.Name):
+                continue
+            fname = stmt.target.id
+            head = _ann_head(stmt.annotation)
+            fcall = _field_call(stmt.value) if stmt.value else None
+            static = fname in meta or (fcall is not None
+                                       and _declares_static(fcall))
+
+            looks_static = head is not None and (
+                head in _STATIC_HEADS or _CONFIG_NAME.search(head))
+            if looks_static and not static:
+                out.append(mod.finding(
+                    "JXL007", stmt,
+                    f"field '{fname}: {head}' of registered dataclass "
+                    f"`{cls.name}` looks static but is flattened as a "
+                    f"TRACED pytree child — declare it "
+                    f"`dataclasses.field(metadata=dict(static=True))` "
+                    f"(or list it in meta_fields), or it traces as a "
+                    f"leaf and every new value retraces.",
+                ))
+            if static and head in _UNHASHABLE_HEADS:
+                out.append(mod.finding(
+                    "JXL007", stmt,
+                    f"static field '{fname}: {head}' of `{cls.name}` is "
+                    f"unhashable: static fields are jit cache keys, the "
+                    f"first traced call raises TypeError. Use a "
+                    f"tuple/frozen container.",
+                ))
+
+            default = stmt.value
+            if fcall is not None:
+                default = next((kw.value for kw in fcall.keywords
+                                if kw.arg == "default"), None)
+            if isinstance(default, _MUTABLE_LITERALS):
+                out.append(mod.finding(
+                    "JXL007", default,
+                    f"mutable literal default for field '{fname}' of "
+                    f"registered dataclass `{cls.name}`: one shared "
+                    f"instance aliases across every constructed state. "
+                    f"Use default_factory or a frozen value.",
+                ))
+    return out

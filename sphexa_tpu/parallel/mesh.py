@@ -93,6 +93,7 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
     ride the slab sharding + the in-step SFC sort.
     """
     from sphexa_tpu.propagator import (
+        STEP_AUX_SLOT,
         step_hydro_std_blockdt,
         step_hydro_std_cooling,
         step_hydro_ve,
@@ -190,6 +191,23 @@ def make_sharded_step(mesh: Mesh, cfg: PropagatorConfig, step_fn=step_hydro_std,
             )
         out = jitted(s, b, gtree, aux)
         return out if step_fn in carry_props else out[:3]
+
+    aux_slot = STEP_AUX_SLOT.get(step_fn)
+
+    def step_sim(sim, gtree=None):
+        """Advance one step on the unified ``state.SimState`` carry:
+        the sharded face of ``propagator.step_sim_state``. Routes through
+        ``stepper`` (same placement commits, same jitted executable —
+        lowering-neutral by construction) and replaces only the aux slot
+        this step function owns, so the carry treedef is closed under
+        stepping (the JXA503 invariant)."""
+        aux = getattr(sim, aux_slot) if aux_slot else None
+        out = stepper(sim.particles, sim.box, gtree, aux)
+        new_sim = sim.with_slot(aux_slot, out[3] if aux_slot else None,
+                                particles=out[0], box=out[1])
+        return new_sim, out[2]
+
+    stepper.step_sim = step_sim
 
     # expose the underlying jit cache so the Simulation's compile
     # watchdog (telemetry retrace events) can probe sharded launches too;

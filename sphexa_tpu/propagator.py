@@ -1424,3 +1424,63 @@ step_hydro_std_blockdt, step_hydro_std_blockdt_donated = _step_pair(
     _step_hydro_std_blockdt, ("cfg",))
 step_hydro_ve_blockdt, step_hydro_ve_blockdt_donated = _step_pair(
     _step_hydro_ve_blockdt, ("cfg",))
+
+
+# ---------------------------------------------------------------------------
+# the unified SimState carry contract
+# ---------------------------------------------------------------------------
+# Each family's step keeps its historical positional signature (the
+# lowering lock pins those byte-identical), but the DISPATCH onto them is
+# one table + one adapter: which SimState aux slot a step function
+# carries, and whether it takes a static aux config. The driver
+# (simulation.py), the sharded stepper (parallel/mesh.py) and the audit
+# registry all route through this mapping, so the carry structure cannot
+# drift per call site.
+
+#: step function -> SimState aux slot it consumes/produces (absent =
+#: plain 3-tuple family with no aux carry)
+STEP_AUX_SLOT = {
+    step_turb_ve: "turb",
+    step_turb_ve_donated: "turb",
+    step_hydro_std_cooling: "chem",
+    step_hydro_std_cooling_donated: "chem",
+    step_hydro_std_blockdt: "bdt",
+    step_hydro_std_blockdt_donated: "bdt",
+    step_hydro_ve_blockdt: "bdt",
+    step_hydro_ve_blockdt_donated: "bdt",
+}
+
+#: aux-carrying steps that ALSO take a static aux config positional
+#: (turbulence / cooling); the blockdt twins carry state only
+STEP_AUX_CFG = {
+    step_turb_ve,
+    step_turb_ve_donated,
+    step_hydro_std_cooling,
+    step_hydro_std_cooling_donated,
+}
+
+
+def step_sim_state(step_fn, sim, cfg, gtree=None, aux_cfg=None, **kw):
+    """Advance one step on a ``state.SimState`` carry.
+
+    Maps the unified carry onto ``step_fn``'s positional contract and
+    folds the outputs back: ``(new_sim, diagnostics)``. Only the slot
+    ``step_fn`` owns is replaced — inactive slots pass through untouched,
+    so the carry treedef is closed under stepping (the JXA503
+    invariant). Pure and trace-safe: usable inside jit/vmap as well as
+    from the host driver.
+    """
+    slot = STEP_AUX_SLOT.get(step_fn)
+    if slot is None:
+        s, b, diag = step_fn(sim.particles, sim.box, cfg, gtree, **kw)
+        return sim.with_slot(None, None, particles=s, box=b), diag
+    aux = getattr(sim, slot)
+    if step_fn in STEP_AUX_CFG:
+        s, b, diag, new_aux = step_fn(
+            sim.particles, sim.box, cfg, gtree, aux, aux_cfg, **kw
+        )
+    else:
+        s, b, diag, new_aux = step_fn(
+            sim.particles, sim.box, cfg, gtree, aux, **kw
+        )
+    return sim.with_slot(slot, new_aux, particles=s, box=b), diag

@@ -36,12 +36,14 @@ from sphexa_tpu.propagator import (
     step_hydro_ve_donated,
     step_nbody,
     step_nbody_donated,
+    step_sim_state,
     step_turb_ve,
     step_turb_ve_donated,
 )
 from sphexa_tpu.sfc.box import BoundaryType, Box
 from sphexa_tpu.sph.blockdt import make_blockdt_state
 from sphexa_tpu.sph.particles import ParticleState, SimConstants
+from sphexa_tpu.state import SimState
 
 _PROPAGATORS: Dict[str, Callable] = {
     "std": step_hydro_std,
@@ -661,7 +663,7 @@ class Simulation:
         # per-run baseline; see _launch_signature)
         self._launched_sigs: set = set()
         self._pending = []  # per-step diagnostics of the open window
-        self._window_prior = None  # sim state refs at the window start
+        self._window_prior = None  # (SimState pin, iteration) at window start
         self._last_diag: Dict[str, float] = {"reconfigured": 0.0}
         self._cfg: Optional[PropagatorConfig] = None
         self._gtree = None
@@ -1099,30 +1101,51 @@ class Simulation:
             }
         return self._checked_cache["fn"]
 
+    @property
+    def _aux_slot(self) -> Optional[str]:
+        """The SimState aux slot the active propagator family carries
+        (None for the plain 3-tuple families) — the driver-level mirror
+        of propagator.STEP_AUX_SLOT, keyed on the configured mode."""
+        if self.prop_name == "turb-ve":
+            return "turb"
+        if self.prop_name == "std-cooling":
+            return "chem"
+        if self._blockdt:
+            return "bdt"
+        return None
+
+    @property
+    def sim_state(self) -> SimState:
+        """The driver's state attributes as the unified carry pytree
+        (state.SimState): what every launch path consumes and returns."""
+        return SimState(particles=self.state, box=self.box,
+                        turb=self.turb_state, chem=self.chem,
+                        bdt=self._bstate)
+
+    def _set_sim_state(self, sim: SimState) -> None:
+        """Write a SimState carry back onto the driver attributes —
+        the single commit point for step outputs AND window rollbacks."""
+        self.state = sim.particles
+        self.box = sim.box
+        self.turb_state = sim.turb
+        self.chem = sim.chem
+        self._bstate = sim.bdt
+
     def _launch_debug(self):
         """Sanitizer-mode launch: run the checkified step and stash the
         checkify Error for _step_checked to surface."""
-        aux = None
-        if self.prop_name == "turb-ve":
-            aux = self.turb_state
-        elif self.prop_name == "std-cooling":
-            aux = self.chem
-        elif self._blockdt:
-            aux = self._bstate
+        sim = self.sim_state
+        slot = self._aux_slot
+        aux = getattr(sim, slot) if slot else None
         self._check_err, out = self._checkified_step()(
-            self.state, self.box, self._gtree, aux
+            sim.particles, sim.box, self._gtree, aux
         )
-        if self.prop_name == "turb-ve":
-            new_state, new_box, diagnostics, new_turb = out
-            return new_state, new_box, diagnostics, new_turb, None, None
-        if self.prop_name == "std-cooling":
-            new_state, new_box, diagnostics, new_chem = out
-            return new_state, new_box, diagnostics, None, new_chem, None
-        if self._blockdt:
-            new_state, new_box, diagnostics, new_bst = out
-            return new_state, new_box, diagnostics, None, None, new_bst
-        new_state, new_box, diagnostics = out
-        return new_state, new_box, diagnostics, None, None, None
+        if slot:
+            new_state, new_box, diagnostics, new_aux = out
+        else:
+            (new_state, new_box, diagnostics), new_aux = out, None
+        return sim.with_slot(slot, new_aux, particles=new_state,
+                             box=new_box), diagnostics
 
     def _compiled_cache_size(self) -> int:
         """Total jit-cache entries behind the ACTIVE launch path — the
@@ -1201,8 +1224,8 @@ class Simulation:
 
     def _launch_impl(self, donate_ok: bool = False):
         """Dispatch one jitted step on the current state (no host sync
-        beyond the CPU-mesh drain). Returns (new_state, new_box,
-        diagnostics, new_turb, new_chem).
+        beyond the CPU-mesh drain). Returns the unified carry:
+        ``(new SimState, diagnostics)`` on every launch path.
 
         ``donate_ok``: the caller guarantees it will never need the
         CURRENT input state again (deferred happy-path windows pin a
@@ -1211,31 +1234,9 @@ class Simulation:
         if self.debug_checks:
             return self._launch_debug()
         if self._mesh is not None:
-            if self.prop_name == "turb-ve":
-                new_state, new_box, diagnostics, new_turb = self._drain(
-                    self._stepper(
-                        self.state, self.box, self._gtree, self.turb_state
-                    )
-                )
-                return new_state, new_box, diagnostics, new_turb, None, None
-            if self.prop_name == "std-cooling":
-                new_state, new_box, diagnostics, new_chem = self._drain(
-                    self._stepper(
-                        self.state, self.box, self._gtree, self.chem
-                    )
-                )
-                return new_state, new_box, diagnostics, None, new_chem, None
-            if self._blockdt:
-                new_state, new_box, diagnostics, new_bst = self._drain(
-                    self._stepper(
-                        self.state, self.box, self._gtree, self._bstate
-                    )
-                )
-                return new_state, new_box, diagnostics, None, None, new_bst
-            new_state, new_box, diagnostics = self._drain(
-                self._stepper(self.state, self.box, self._gtree)
+            return self._drain(
+                self._stepper.step_sim(self.sim_state, self._gtree)
             )
-            return new_state, new_box, diagnostics, None, None, None
         donate_now = donate_ok and self._donate_active
         if donate_now:
             # freshly-built states alias leaves (build_state shares one
@@ -1245,42 +1246,20 @@ class Simulation:
             # only ever pays on the first donated launch of a state)
             self.state = _dealias_leaves(self.state)
         step_fn = self._step_fn(donated=donate_now)
-        new_turb, new_chem, new_bst = None, None, None
         kw = {}
         if self._use_lists:
             if self._lists is None:
                 self._rebuild_lists()
             kw["lists"] = self._lists
-        if self.prop_name == "turb-ve":
-            new_state, new_box, diagnostics, new_turb = step_fn(
-                self.state, self.box, self._cfg, self._gtree,
-                self.turb_state, self.turb_cfg, **kw,
-            )
-        elif self.prop_name == "std-cooling":
-            new_state, new_box, diagnostics, new_chem = step_fn(
-                self.state, self.box, self._cfg, self._gtree,
-                self.chem, self.cooling_cfg, **kw,
-            )
-        elif self._blockdt:
-            new_state, new_box, diagnostics, new_bst = step_fn(
-                self.state, self.box, self._cfg, self._gtree, self._bstate
-            )
-        else:
-            new_state, new_box, diagnostics = step_fn(
-                self.state, self.box, self._cfg, self._gtree, **kw
-            )
-        return new_state, new_box, diagnostics, new_turb, new_chem, new_bst
+        aux_cfg = (self.turb_cfg if self.prop_name == "turb-ve"
+                   else self.cooling_cfg if self.prop_name == "std-cooling"
+                   else None)
+        return step_sim_state(step_fn, self.sim_state, self._cfg,
+                              self._gtree, aux_cfg, **kw)
 
     def _apply(self, out):
-        new_state, new_box, _, new_turb, new_chem, new_bst = out
-        self.state = new_state
-        self.box = new_box
-        if new_turb is not None:
-            self.turb_state = new_turb
-        if new_chem is not None:
-            self.chem = new_chem
-        if new_bst is not None:
-            self._bstate = new_bst
+        sim, _diagnostics = out
+        self._set_sim_state(sim)
 
     @staticmethod
     def _scalar_view(diagnostics) -> Dict:
@@ -1594,7 +1573,7 @@ class Simulation:
         t0 = time.perf_counter()
         for _attempt in range(4):
             out = self._launch()
-            diagnostics = {**out[2], **self._fetch_scalars(out[2])}
+            diagnostics = {**out[1], **self._fetch_scalars(out[1])}
             if not self._overflowed(diagnostics):
                 break
             if not self._lists_fresh(diagnostics):
@@ -1688,16 +1667,19 @@ class Simulation:
             pin = self.state
             if self._donate_active:
                 pin = jax.tree.map(jnp.copy, self.state)
-            # _bstate is never donated, so the pin is a reference
-            self._window_prior = (pin, self.box, self.turb_state,
-                                  self.chem, self.iteration, self._bstate)
+            # aux slots (turb/chem/_bstate) are never donated, so the
+            # carry pin holds them by reference around the copied slab
+            self._window_prior = (
+                dataclasses.replace(self.sim_state, particles=pin),
+                self.iteration,
+            )
         out = self._launch(donate_ok=True)
         self._apply(out)
         self.iteration += 1
         # happy-path telemetry is launch-count only: diagnostics stay on
         # device, timestamps are host-side — zero added transfers
         self.telemetry.event("launch", it=self.iteration)
-        self._pending.append(out[2])
+        self._pending.append(out[1])
         if len(self._pending) >= self.check_every:
             return self.flush()
         return {**self._last_diag, "deferred": 1.0}
@@ -1763,12 +1745,12 @@ class Simulation:
         )
         self.telemetry.count("rollbacks")
         self.telemetry.event(
-            "rollback", it=self.iteration, to_it=prior[4],
+            "rollback", it=self.iteration, to_it=prior[1],
             steps=len(pending), bad_index=bad,
             reason="list-expiry" if expiry_only else "overflow",
         )
-        (self.state, self.box, self.turb_state, self.chem,
-         self.iteration, self._bstate) = prior
+        self._set_sim_state(prior[0])
+        self.iteration = prior[1]
         if expiry_only:
             # expiry only: fresh lists on the rolled-back state suffice
             self._rebuild_lists()

@@ -1,0 +1,62 @@
+"""The unified simulation carry pytree.
+
+``SimState`` is the ONE structure every step of every propagator family
+maps onto: the particle slab + box that all families share, plus one
+optional aux slot per family extension (turbulence phases, chemistry
+fractions, block-timestep bins). Historically the ``Simulation`` driver
+threaded an ad-hoc 6-tuple ``(state, box, diagnostics, turb, chem,
+bstate)`` with ``None`` padding per family — a shape no tool could
+verify and ``jax.vmap`` could not batch. As a registered dataclass the
+carry is an explicit pytree: statecheck (devtools/audit/statecheck.py)
+locks its per-leaf schema in STATE_SCHEMA.json and proves carry closure
+(JXA503), and ensemble serving (ROADMAP item 3) can vmap a member axis
+over it under one compile.
+
+Inactive slots hold ``None`` — jax treats ``None`` as an empty subtree,
+so a slot flipping ``None``<->array between steps CHANGES the carry's
+treedef (a guaranteed retrace). The driver therefore builds the
+``SimState`` once from its attributes and only ever *replaces* the
+active slot; JXA503 makes that invariant statically checkable.
+
+The module is import-light on purpose (jax + dataclasses only): the
+audit registry and the lint layer both touch it without pulling the
+physics stack.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["SimState", "AUX_SLOTS"]
+
+#: family-extension slots, in carry order (turb-ve / std-cooling /
+#: blockdt twins); exactly one is non-None for a given propagator family
+AUX_SLOTS = ("turb", "chem", "bdt")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SimState:
+    """Full per-member simulation state: what one step consumes and
+    (diagnostics aside) what it produces — structurally closed under
+    stepping, per family."""
+
+    particles: Any                 # sph.particles.ParticleState
+    box: Any                       # sfc.box.Box
+    turb: Optional[Any] = None     # sph.hydro_turb.TurbulenceState
+    chem: Optional[Any] = None     # physics.cooling.ChemistryData
+    bdt: Optional[Any] = None      # sph.blockdt.BlockDtState
+
+    def with_slot(self, slot: Optional[str], value: Any,
+                  particles: Any = None, box: Any = None) -> "SimState":
+        """Copy with the named aux slot (and optionally particles/box)
+        replaced; ``slot=None`` replaces particles/box only."""
+        kw = {}
+        if particles is not None:
+            kw["particles"] = particles
+        if box is not None:
+            kw["box"] = box
+        if slot is not None:
+            kw[slot] = value
+        return dataclasses.replace(self, **kw)

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
 
 
-@entrypoint("bad_dtype_carry", phase_coverage_min=0.0)  # expect: JXA102
+@entrypoint("bad_dtype_carry", phase_coverage_min=0.0)  # expect: JXA102, JXA503
 def bad_dtype_carry():
     def fn(x, t):
         return x * 2.0, (t + 1.0).astype(jnp.bfloat16)

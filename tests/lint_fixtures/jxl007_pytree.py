@@ -1,0 +1,59 @@
+"""JXL007 fixture: register_dataclass pytree-registration hygiene."""
+
+import dataclasses
+from functools import partial
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class UndeclaredStatics:
+    x: jax.Array
+    mode: str                                           # expect: JXL007
+    caps: Tuple[int, int]                               # expect: JXL007
+    cfg: "StirConfig"                                   # expect: JXL007
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class UnhashableStatic:
+    x: jax.Array
+    tags: List[str] = dataclasses.field(                # expect: JXL007
+        metadata=dict(static=True), default=()
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MutableDefault:
+    x: jax.Array
+    history: Any = []                                   # expect: JXL007
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=("lo", "hi"), meta_fields=("kind",))
+@dataclasses.dataclass
+class CleanMetaFields:
+    lo: jax.Array
+    hi: jax.Array
+    kind: str = "open"                                  # ok: in meta_fields
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CleanExplicit:
+    # the Box.boundaries idiom: static-shaped, declared static, hashable
+    lo: jax.Array
+    kinds: Tuple[str, str, str] = dataclasses.field(
+        metadata=dict(static=True), default=("open", "open", "open")
+    )
+    aux: Optional[Any] = None                           # ok: pytree slot
+
+
+@dataclasses.dataclass
+class PlainDataclass:
+    # not registered as a pytree: nothing to declare
+    mode: str = "fast"
+    history: Any = None

@@ -1,0 +1,41 @@
+"""JXA501 fixtures: schema drift vs a doctored committed lock.
+
+``drifting_schema``'s row in the sibling ``jxa501_schema.json`` records
+its scalar output as float64 — the live trace produces float32, so the
+drift rule fires with a per-leaf diff. ``stable_schema``'s row matches
+exactly and stays clean; ``unlocked_schema`` has NO row, which is the
+CLI's missing-from-lock business, never a rule finding.
+
+Run by tests/test_statecheck.py with the audit context's
+``state_schema_path`` pointed at the doctored lock (the committed
+STATE_SCHEMA.json knows nothing about fixture entries, so these are
+invisible to the package gate).
+"""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+
+@entrypoint("drifting_schema", phase_coverage_min=0.0)  # expect: JXA501
+def drifting_schema():
+    def fn(x):
+        return x * 2.0, x.sum()
+
+    return EntryCase(fn=fn, args=(jnp.zeros(8, jnp.float32),))
+
+
+@entrypoint("stable_schema", phase_coverage_min=0.0)
+def stable_schema():
+    def fn(x):
+        return x + 1.0
+
+    return EntryCase(fn=fn, args=(jnp.zeros(8, jnp.float32),))
+
+
+@entrypoint("unlocked_schema", phase_coverage_min=0.0)
+def unlocked_schema():
+    def fn(x):
+        return x - 1.0
+
+    return EntryCase(fn=fn, args=(jnp.zeros(8, jnp.float32),))

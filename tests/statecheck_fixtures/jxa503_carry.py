@@ -1,0 +1,57 @@
+"""JXA503 fixtures: carries not closed under the step.
+
+``none_flip_carry`` is the structural break the unified SimState carry
+exists to catch: an aux slot that is ``None`` on step 1 comes back as
+an array on step 2 — the treedef itself changes, scan rejects it, and
+a flat leaf zip (JXA102's view) cannot anchor the break to a path.
+``aval_drift_carry`` keeps the structure but widens a leaf's rank —
+the per-leaf closure layer fires. ``closed_carry`` is the honest twin:
+outputs rearrange into step-2 args with identical treedef and avals.
+
+Run by tests/test_statecheck.py under ``select=["JXA503"]`` (in the
+full-rule package audit JXA102 co-fires on aval drift by design — the
+two rules report different consequences of the same break).
+"""
+
+import jax.numpy as jnp
+
+from sphexa_tpu.devtools.audit.core import EntryCase, entrypoint
+
+
+@entrypoint("none_flip_carry", phase_coverage_min=0.0)  # expect: JXA503
+def none_flip_carry():
+    def fn(x, aux):
+        del aux  # step 1 runs with the slot empty...
+        return x * 2.0, x.sum()
+
+    return EntryCase(
+        fn=fn,
+        args=(jnp.zeros(8, jnp.float32), None),
+        # ...but the carry writes the scalar INTO the slot: None on
+        # step 1, array on step 2 — the treedef flips
+        carry=lambda a, out: (out[0], out[1]),
+    )
+
+
+@entrypoint("aval_drift_carry", phase_coverage_min=0.0)  # expect: JXA503
+def aval_drift_carry():
+    def fn(x):
+        return jnp.stack([x, x])
+
+    return EntryCase(
+        fn=fn,
+        args=(jnp.zeros(8, jnp.float32),),
+        carry=lambda a, out: (out,),  # f32[8] in, f32[2,8] back
+    )
+
+
+@entrypoint("closed_carry", phase_coverage_min=0.0)
+def closed_carry():
+    def fn(x, s):
+        return x * 2.0, s + 1.0
+
+    return EntryCase(
+        fn=fn,
+        args=(jnp.zeros(8, jnp.float32), jnp.float32(0.0)),
+        carry=lambda a, out: (out[0], out[1]),
+    )

@@ -37,7 +37,14 @@ _EXPECT_RE = re.compile(
 ALL_RULE_IDS = ["JXA101", "JXA102", "JXA103", "JXA104", "JXA105", "JXA106",
                 "JXA201", "JXA202", "JXA203", "JXA204",
                 "JXA301", "JXA302", "JXA303",
-                "JXA401", "JXA402"]
+                "JXA401", "JXA402",
+                "JXA501", "JXA502", "JXA503"]
+
+# the JXA5xx statecheck fixtures need a controlled context (doctored
+# schema lock path, vmap_members on) so they live in their own dir with
+# their own runner (tests/test_statecheck.py); the firing-fixture
+# acceptance scan below covers both dirs
+STATECHECK_FIXTURES = Path(__file__).resolve().parent / "statecheck_fixtures"
 
 
 def expected_findings(path: Path):
@@ -99,6 +106,8 @@ def test_every_rule_has_a_firing_fixture():
     fired = set()
     for rel in FIXTURE_FILES:
         fired |= {rule for _line, rule in expected_findings(FIXTURES / rel)}
+    for p in sorted(STATECHECK_FIXTURES.rglob("*.py")):
+        fired |= {rule for _line, rule in expected_findings(p)}
     assert fired == set(ALL_RULE_IDS), (
         f"rules without a firing fixture: {set(ALL_RULE_IDS) - fired}"
     )

@@ -47,7 +47,7 @@ FIXTURE_FILES = sorted(
 def test_rule_registry_complete():
     rules = all_rules()
     assert sorted(rules) == ["JXL001", "JXL002", "JXL003", "JXL004",
-                             "JXL005", "JXL006"]
+                             "JXL005", "JXL006", "JXL007"]
     for rule in rules.values():
         assert rule.description
 
