@@ -11,6 +11,7 @@
 #   scripts/check.sh --telemetry-only
 #   scripts/check.sh --history-only
 #   scripts/check.sh --tuning-only
+#   scripts/check.sh --serve-only
 #   scripts/check.sh --lowering-only
 #   scripts/check.sh --schema-only
 set -uo pipefail
@@ -347,6 +348,116 @@ run_blockdt() {
     rm -rf "$dir"
 }
 
+run_serve() {
+    echo "== live science surface (snapshot ring -> sphexa-telemetry serve) =="
+    local dir rc
+    dir=$(mktemp -d)
+    # 5-step 2-virtual-device deferred run with in-graph snapshots ON:
+    # the schema-v8 smoke — snapshot events + .npz ring frames must land
+    # at the flush boundary and validate strictly
+    python -m sphexa_tpu.app.main \
+        --init sedov -n 8 -s 5 --quiet \
+        --devices 2 --cpu-mesh --backend pallas --check-every 5 \
+        --snap rho --snap-grid 16 \
+        --telemetry-dir "$dir/fleet/run_a" -o "$dir/out_a"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "snapshot smoke run failed (rc=$rc)"
+        rm -rf "$dir"
+        exit $rc
+    fi
+    if ! ls "$dir/fleet/run_a/snapshots/"*.npz >/dev/null 2>&1; then
+        echo "the snapshot run wrote no .npz ring frames"
+        echo "(observables/snapshot.py, simulation._emit_snapshot)."
+        rm -rf "$dir"
+        exit 1
+    fi
+    python -m sphexa_tpu.telemetry summary "$dir/fleet/run_a" --strict
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "strict schema validation failed on the snapshot run"
+        echo "(rc=$rc): the schema-v8 snapshot event drifted from the"
+        echo "registry (docs/OBSERVABILITY.md, telemetry/registry.py)."
+        exit $rc
+    fi
+    # doctored crash member: a second run whose flight recorder dumped —
+    # the fleet page must render it as a CRASH card, not hide it
+    env JAX_PLATFORMS=cpu python - "$dir/fleet/run_b" <<'EOF'
+import sys
+
+from sphexa_tpu.init import make_initializer
+from sphexa_tpu.observables import SnapshotSpec
+from sphexa_tpu.simulation import Simulation
+from sphexa_tpu.telemetry import FlightRecorder, JsonlSink, Telemetry
+
+d = sys.argv[1]
+tel = Telemetry(sinks=[JsonlSink(d + "/events.jsonl")])
+rec = FlightRecorder(d, telemetry=tel)
+tel.sinks.append(rec.sink)
+state, box, const = make_initializer("sedov")(6)
+sim = Simulation(state, box, const, prop="std", block=512, telemetry=tel,
+                 snap_spec=SnapshotSpec(fields=("rho",), grid=16),
+                 snap_dir=d + "/snapshots")
+sim.step()
+rec.dump(reason="check.sh doctored crash: SIGKILL rehearsal")
+rec.close()
+tel.close()
+EOF
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "doctored-crash member build failed (rc=$rc)"
+        rm -rf "$dir"
+        exit $rc
+    fi
+    # serve --once over the 2-run fleet: ONE self-contained HTML page
+    # with both members, an inline frame, and the crash rendered red
+    python -m sphexa_tpu.telemetry serve "$dir/fleet" \
+        --once --out "$dir/dash.html"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "sphexa-telemetry serve --once failed (rc=$rc) on a"
+        echo "readable 2-run fleet (telemetry/serve.py)."
+        exit $rc
+    fi
+    if ! grep -q "run_a" "$dir/dash.html" \
+            || ! grep -q "run_b" "$dir/dash.html" \
+            || ! grep -q "data:image/png;base64," "$dir/dash.html" \
+            || ! grep -q "CRASH" "$dir/dash.html"; then
+        echo "the fleet page lost a member, the inline ring frame, or"
+        echo "the CRASH section (telemetry/serve.py render pipeline)."
+        rm -rf "$dir"
+        exit 1
+    fi
+    # fleet table over the same dirs (the one-line-per-run view)
+    python -m sphexa_tpu.telemetry fleet "$dir/fleet" >/dev/null
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        rm -rf "$dir"
+        echo "sphexa-telemetry fleet failed (rc=$rc) on a readable fleet"
+        exit $rc
+    fi
+    # exit-code contract smokes: nothing matched = 1, all-corrupt = 2
+    python -m sphexa_tpu.telemetry serve "$dir/no_such_*" --once \
+        --out "$dir/none.html" 2>/dev/null
+    if [ $? -ne 1 ]; then
+        echo "serve failed to exit 1 when no run dirs matched"
+        rm -rf "$dir"
+        exit 1
+    fi
+    mkdir -p "$dir/corrupt_run"
+    echo "{not json" > "$dir/corrupt_run/events.jsonl"
+    python -m sphexa_tpu.telemetry serve "$dir/corrupt_run" --once \
+        --out "$dir/corrupt.html" 2>/dev/null
+    if [ $? -ne 2 ]; then
+        echo "serve failed to exit 2 when every matched run is unreadable"
+        rm -rf "$dir"
+        exit 1
+    fi
+    rm -rf "$dir"
+}
+
 run_lowering() {
     echo "== jaxdiff lowering lock (fingerprint verify vs LOWERING_LOCK.json) =="
     local tmp rc
@@ -519,6 +630,10 @@ case "${1:-}" in
         run_blockdt
         exit 0
         ;;
+    --serve-only)
+        run_serve
+        exit 0
+        ;;
     --lowering-only)
         run_lowering
         exit 0
@@ -537,6 +652,7 @@ run_telemetry
 run_history
 run_tuning
 run_blockdt
+run_serve
 run_lowering
 run_schema
 run_multichip_diff

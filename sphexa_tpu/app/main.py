@@ -137,10 +137,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a jax.profiler device-memory profile "
                         "(pprof) to this path at the end of the run")
     p.add_argument("--insitu", default=None,
-                   help="in-situ rendering per iteration: slice | projection "
-                        "(the Ascent/Catalyst adaptor role, ascent_adaptor.h)")
+                   help="in-situ rendering: slice | projection (the "
+                        "Ascent/Catalyst adaptor role, ascent_adaptor.h). "
+                        "Frames render from the in-graph snapshot ring at "
+                        "the check/flush boundary — zero added host syncs "
+                        "(docs/OBSERVABILITY.md schema v8)")
     p.add_argument("--insitu-every", type=int, default=1, dest="insitu_every",
                    help="render every N iterations (default 1)")
+    p.add_argument("--snap", default=None,
+                   help="in-graph field snapshots riding the flush "
+                        "boundary: comma-separated field list (e.g. "
+                        "'rho' or 'rho,temp'; observables/snapshot.py). "
+                        "Emits schema-v8 snapshot events + a snapshots/ "
+                        ".npz ring next to events.jsonl (or --output)")
+    p.add_argument("--snap-grid", type=int, default=16, dest="snap_grid",
+                   help="snapshot grid side G (G x G projection) [16]")
+    p.add_argument("--snap-every", type=int, default=None,
+                   dest="snap_every",
+                   help="emit a snapshot frame every N iterations "
+                        "[--insitu-every when --insitu is on, else 1]")
+    p.add_argument("--snap-keep", type=int, default=32, dest="snap_keep",
+                   help="snapshot ring capacity in .npz frames (0 = "
+                        "unbounded) [32]")
     p.add_argument("--kernel", default=None,
                    help="SPH kernel family: sinc | sinc-n1-n2 | wendland-c6 "
                         "(sph_kernel_tables.hpp SphKernelType)")
@@ -341,6 +359,36 @@ def main(argv=None) -> int:
         recorder = FlightRecorder(args.telemetry_dir, telemetry=telemetry)
         telemetry.sinks.append(recorder.sink)
         recorder.install()
+
+    # --snap: in-graph field snapshots riding the flush boundary
+    # (observables/snapshot.py). --insitu without an explicit --snap
+    # defaults to a density grid so the viz hook consumes the ring
+    # instead of syncing full particle state every frame.
+    snap_spec = None
+    snap_every = None
+    snap_dir = None
+    snap_fields = None
+    if args.snap:
+        snap_fields = tuple(f.strip() for f in args.snap.split(",")
+                            if f.strip())
+    elif args.insitu:
+        snap_fields = ("rho",)
+    if snap_fields:
+        from sphexa_tpu.observables.snapshot import SnapshotSpec
+
+        try:
+            snap_spec = SnapshotSpec(fields=snap_fields, grid=args.snap_grid)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            if recorder is not None:
+                recorder.close()  # usage error, not a crash: no blackbox
+            return 2
+        snap_every = args.snap_every or (
+            args.insitu_every if args.insitu else 1)
+        if args.telemetry_dir:
+            snap_dir = os.path.join(args.telemetry_dir, "snapshots")
+        else:
+            snap_dir = os.path.join(args.out_dir, "snapshots")
     try:
         sim = Simulation(state, box, const, prop=args.prop,
                          av_clean=args.avclean and args.prop in ("ve", "turb-ve"),
@@ -356,6 +404,8 @@ def main(argv=None) -> int:
                          bin_resort_drift=args.bin_resort_drift,
                          imbalance_ratio=args.imbalance_ratio,
                          obs_spec=obs_spec, science_rows=True,
+                         snap_spec=snap_spec, snap_every=snap_every,
+                         snap_keep=args.snap_keep, snap_dir=snap_dir,
                          drift_budget=args.drift_budget,
                          debug_checks=args.debug_checks, telemetry=telemetry,
                          tuned=args.tuned,
@@ -587,6 +637,22 @@ def main(argv=None) -> int:
             return 2
         insitu.init()
 
+    def consume_snapshots():
+        """Feed the in-graph snapshot ring into the viz hook. The frames
+        were deposited inside the step and landed at the existing check/
+        flush boundary (sim._emit_snapshot), so rendering here is pure
+        host pixel work — no device sync, no full-state fetch (the old
+        insitu.execute path pulled every particle array per frame)."""
+        for fit, fpath in sim.drain_snapshots():
+            if insitu is None:
+                continue
+            try:
+                with np.load(fpath, allow_pickle=False) as z:
+                    grid = np.asarray(z["grid"])
+            except (OSError, ValueError, KeyError):
+                continue  # frame pruned from the ring / partial write
+            insitu.execute_grid(grid, fit)
+
     profile = ProfileRecorder()
     t0 = time.time()
     it0 = sim.iteration
@@ -636,8 +702,7 @@ def main(argv=None) -> int:
             rows = write_science_rows()
             timer.step("observables")
             maybe_dump(it)  # dumps recompute the full derived set (r, p, u, ...)
-            if insitu is not None:
-                insitu.execute(sim.state, sim.box, it)
+            consume_snapshots()  # ring frames -> PNG (when --insitu)
             timer.step("output")
             laps = timer.pop()
             telemetry.event(
@@ -702,6 +767,7 @@ def main(argv=None) -> int:
     # flush, mirrored) and the window's constants.txt rows with them
     sim.flush()
     write_science_rows()
+    consume_snapshots()  # frames landed by the trailing flush
     dt_wall = time.time() - t0
     n_done = sim.iteration - it0
     if args.profile:

@@ -619,6 +619,75 @@ def observable_ledger_sharded():
 
 
 # ---------------------------------------------------------------------------
+# in-graph field snapshot (observables/snapshot.py) — the fixed-shape
+# scatter-add deposit the live-science surface rides; audited standalone
+# (like the ledger) single-device and over a 2-device mesh, where the
+# replicated grid output makes GSPMD insert exactly one psum for the
+# whole stacked (F, G*G) deposit
+# ---------------------------------------------------------------------------
+
+
+# jaxaudit: disable=JXA502 -- the snapshot's chain_after (the same
+# collective-order fence as the ledger's, JXA401) has no vmap batching
+# rule in this jax; ensembles snapshot per member OUTSIDE the batched
+# step
+# jaxaudit: disable=JXA401 -- the deposit is a colliding histogram
+# scatter BY DESIGN (many particles per cell); the grid is a viz/
+# monitoring surface whose contract is the cell sum up to rounding,
+# not bitwise replay — the science ledger (observable_ledger) keeps
+# the deterministic pinned-order path
+@entrypoint("observable_snapshot")
+def observable_snapshot():
+    import jax.numpy as jnp
+
+    from sphexa_tpu.observables.snapshot import (
+        SnapshotSpec,
+        snapshot_diagnostics,
+    )
+
+    sim = _sim("sedov", _SIDE, prop="std")
+    s, box = sim.state, sim.box
+    # exercises the multi-field stack AND the particle-subsample tap
+    spec = SnapshotSpec(fields=("rho", "temp"), grid=8, stride=7)
+    rho = jnp.ones_like(s.m)
+
+    def fn(state, b, rho):
+        return snapshot_diagnostics(state, rho, b, spec)
+
+    return EntryCase(fn=fn, args=(s, box, rho))
+
+
+# jaxaudit: disable=JXA502 -- same optimization_barrier fence as above
+# jaxaudit: disable=JXA401 -- same deliberate histogram scatter as the
+# single-device entry above
+@entrypoint("observable_snapshot_sharded", mesh_axes=("p",))
+def observable_snapshot_sharded():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from sphexa_tpu.init import make_initializer
+    from sphexa_tpu.observables.snapshot import (
+        SnapshotSpec,
+        snapshot_diagnostics,
+    )
+    from sphexa_tpu.parallel import make_mesh, shard_state
+
+    P, side = _mesh_size_and_side()
+    state, box, const = make_initializer("sedov")(side)
+    mesh = make_mesh(P)
+    sstate = shard_state(state, mesh)
+    pspec = NamedSharding(mesh, PartitionSpec("p"))
+    rho = jax.device_put(jnp.ones((state.n,)), pspec)
+    spec = SnapshotSpec(fields=("rho",), grid=8)
+
+    def fn(st, rho, b):
+        return snapshot_diagnostics(st, rho, b, spec)
+
+    return EntryCase(fn=jax.jit(fn), args=(sstate, rho, box))
+
+
+# ---------------------------------------------------------------------------
 # tree build / sizing (parallel/sizing.py)
 # ---------------------------------------------------------------------------
 

@@ -25,6 +25,11 @@ from sphexa_tpu.observables.ledger import (
     ledger_diagnostics,
     make_observable_spec,
 )
+from sphexa_tpu.observables.snapshot import (
+    SNAP_DIAG_KEYS,
+    SnapshotSpec,
+    snapshot_diagnostics,
+)
 
 __all__ = [
     "conserved_quantities",
@@ -40,4 +45,7 @@ __all__ = [
     "BASE_COLUMNS",
     "OBS_DIAG_KEYS",
     "NUM_DIAG_KEYS",
+    "SnapshotSpec",
+    "snapshot_diagnostics",
+    "SNAP_DIAG_KEYS",
 ]

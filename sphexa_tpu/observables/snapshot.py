@@ -1,0 +1,167 @@
+"""In-graph field snapshots: fixed-shape downsampled field grids deposited
+INSIDE the jitted step and fetched at the existing check/flush boundary.
+
+The reference's in-situ leg hands the full mesh to Ascent / ParaView
+Catalyst adaptors around the main loop (``main/src/ascent_adaptor.h``,
+``catalyst_adaptor.h``; Ayachit et al. 2015, Larsen et al. 2017). The
+TPU-era translation of their "reduce on the compute resource, ship only
+render-ready extracts" principle is this module: a static, hashable
+``SnapshotSpec`` lowers to one scatter-add deposit per step — a
+``(F, G, G)`` column projection (or ``(F, G, G, G)`` volume) plus an
+optional strided particle subsample — that rides the diagnostics dict
+exactly like the PR 6 science ledger (``SNAP_DIAG_KEYS``, the
+``SHARD_DIAG_KEYS`` conditionality pattern). The Simulation fetches the
+grids in its ONE batched transfer at the check/flush boundary, so
+snapshots add ZERO host syncs to a deferred window — unlike the old
+``--insitu`` path, which pulled the full state per rendered frame.
+
+Sharding: the deposit runs in the unsharded step tail, so GSPMD turns
+the scatter-add over sharded ``(N,)`` fields into per-shard partial
+grids psum-reduced into the replicated output — 2-device == 1-device is
+pinned (up to float summation order) by tests/test_serve.py.
+
+Collective ordering: the psum'd deposit is one more collective on
+XLA:CPU's rendezvous-racing meshes (the PR-5 class), so the deposit
+input is chained (``exchange.chain_after``) onto the step's last
+collective — the ledger's final min sweep when ``cfg.obs`` is set, the
+shard-metrics gather otherwise.
+"""
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from sphexa_tpu.util.phases import named_phase
+
+#: snapshot diagnostics the step tail emits whenever a
+#: PropagatorConfig.snap spec is set (None = bare steps compile without
+#: any snapshot scope; consumers must .get()). ``snap_grid`` is the
+#: (F, G, G) (or (F, G, G, G)) deposited field grid, ``snap_min`` /
+#: ``snap_max`` the per-field grid extrema, ``snap_pts`` the optional
+#: strided particle subsample ((3 + F, ceil(N / stride))).
+SNAP_DIAG_KEYS = ("snap_grid", "snap_min", "snap_max", "snap_pts")
+
+#: field names a spec may request: "rho" is the force stage's density
+#: (post-step order, the same pairing the ledger uses); the rest are
+#: ParticleState attributes
+SNAP_FIELDS = ("rho", "m", "temp", "vx", "vy", "vz", "h", "du")
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotSpec:
+    """Static (hashable) description of the in-graph snapshot — a jit
+    compile-time constant like ObservableSpec, so every shape below is
+    fixed and ``snap=None`` steps lower with no snapshot ops at all.
+
+    ``fields``: names from SNAP_FIELDS, deposited as scatter weights.
+    ``grid``: side G of the deposit grid.
+    ``axis``: projection axis for the 2D deposit (2 = project along z
+    onto the (x, y) plane, matching ``viz.render_field``).
+    ``reduce``: "sum" (column density deposit) or "max" (peak value).
+    ``stride``: > 0 ships every stride-th particle's position + fields
+    as ``snap_pts`` alongside the grids; 0 = grids only.
+    ``volume``: True deposits the full (F, G, G, G) volume instead of
+    the axis projection.
+    """
+
+    fields: Tuple[str, ...] = ("rho",)
+    grid: int = 16
+    axis: int = 2
+    reduce: str = "sum"
+    stride: int = 0
+    volume: bool = False
+
+    def __post_init__(self):
+        object.__setattr__(self, "fields", tuple(self.fields))
+        if not self.fields:
+            raise ValueError("SnapshotSpec.fields must name >= 1 field")
+        for f in self.fields:
+            if f not in SNAP_FIELDS:
+                raise ValueError(f"unknown snapshot field {f!r}; "
+                                 f"choices: {list(SNAP_FIELDS)}")
+        if self.grid < 2:
+            raise ValueError("SnapshotSpec.grid must be >= 2")
+        if self.axis not in (0, 1, 2):
+            raise ValueError("SnapshotSpec.axis must be 0, 1 or 2")
+        if self.reduce not in ("sum", "max"):
+            raise ValueError("SnapshotSpec.reduce must be 'sum' or 'max'")
+        if self.stride < 0:
+            raise ValueError("SnapshotSpec.stride must be >= 0")
+
+
+def _field_values(state, rho, name: str):
+    return rho if name == "rho" else getattr(state, name)
+
+
+@named_phase("snapshot")
+def snapshot_diagnostics(state, rho, box,
+                         spec: SnapshotSpec,
+                         token=None) -> Dict[str, jnp.ndarray]:
+    """The in-graph deposit: SNAP_DIAG_KEYS over the post-integration
+    state. ``rho`` is the force stage's density in the step's order;
+    ``token`` anchors the deposit after the step's last collective
+    (defaults to ``state.min_dt``, the ledger/``chain_after`` pattern).
+
+    The whole snapshot lowers to ONE scatter (all fields stacked into a
+    (F, N) weight sweep against one flattened cell-index vector) plus
+    the per-field extrema reductions over the G-sized grid — under
+    sharding that is a single psum'd deposit, keeping the collective
+    count flat in F like the ledger's stacked reductions.
+    """
+    from sphexa_tpu.parallel.exchange import chain_after
+
+    G = spec.grid
+    lo = box.lo
+    lengths = box.lengths
+
+    def cell_index(coord, d):
+        # clip keeps escaped particles (pre-regrow positions) in the
+        # boundary cells instead of wrapping the deposit
+        u = (coord - lo[d]) / lengths[d]
+        return jnp.clip((u * G).astype(jnp.int32), 0, G - 1)
+
+    pos = (state.x, state.y, state.z)
+    w = jnp.stack([_field_values(state, rho, f) for f in spec.fields])
+    root = state.min_dt if token is None else token
+    w = chain_after(w, root)
+
+    if spec.volume:
+        i0 = cell_index(pos[0], 0)
+        i1 = cell_index(pos[1], 1)
+        i2 = cell_index(pos[2], 2)
+        flat = (i0 * G + i1) * G + i2
+        shape = (len(spec.fields), G, G, G)
+    else:
+        rem = tuple(d for d in (0, 1, 2) if d != spec.axis)
+        # row index = second remaining axis, col = first — the
+        # orientation viz.render_field uses for its (y, x) histogram
+        rows = cell_index(pos[rem[1]], rem[1])
+        cols = cell_index(pos[rem[0]], rem[0])
+        flat = rows * G + cols
+        shape = (len(spec.fields), G, G)
+
+    F = len(spec.fields)
+    if spec.reduce == "sum":
+        g = jnp.zeros((F, G ** (3 if spec.volume else 2)),
+                      dtype=w.dtype).at[:, flat].add(w)
+    else:
+        neg = jnp.finfo(w.dtype).min
+        g = jnp.full((F, G ** (3 if spec.volume else 2)), neg,
+                     dtype=w.dtype).at[:, flat].max(w)
+        g = jnp.where(g == neg, jnp.zeros((), w.dtype), g)
+    grid = g.reshape(shape)
+
+    out = {
+        "snap_grid": grid,
+        # extrema over the (replicated) grid: cheap, collective-free
+        "snap_min": jnp.min(g, axis=1),
+        "snap_max": jnp.max(g, axis=1),
+    }
+    if spec.stride > 0:
+        s = spec.stride
+        sub = jnp.stack([chain_after(pos[0], g[0, 0])[::s],
+                         pos[1][::s], pos[2][::s]]
+                        + [row[::s] for row in w])
+        out["snap_pts"] = sub
+    return out

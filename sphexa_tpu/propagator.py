@@ -29,6 +29,11 @@ from sphexa_tpu.observables.ledger import (
     ObservableSpec,
     ledger_diagnostics,
 )
+from sphexa_tpu.observables.snapshot import (
+    SNAP_DIAG_KEYS,
+    SnapshotSpec,
+    snapshot_diagnostics,
+)
 from sphexa_tpu.sfc.box import Box, make_global_box, put_in_box
 from sphexa_tpu.sfc.keys import compute_sfc_keys
 from sphexa_tpu.sph import blockdt as bdt
@@ -182,6 +187,10 @@ class PropagatorConfig:
     # case observable computed in-graph alongside the conservation
     # ledger (observables/ledger.py); None = energies only
     obs: Optional[ObservableSpec] = None
+    # in-graph downsampled field-grid snapshot (observables/snapshot.py);
+    # None is never read by the step builders, so unset leaves every
+    # lowering byte-identical (the dt_bins pattern)
+    snap: Optional[SnapshotSpec] = None
     # Verlet skin as a fraction of the 2*h_max search radius: larger =
     # fewer rebuilds but more candidate lanes per target
     list_skin_rel: float = 0.2
@@ -505,6 +514,17 @@ def _integrate_and_finish(
             # ledger's reductions after it so the two collective families
             # stay totally ordered (the XLA:CPU rendezvous guard)
             token=ed.get("shard_trips"),
+        ))
+    # in-graph snapshot deposit over the same post-integration state
+    # (observables/snapshot.py). Conditional exactly like cfg.obs: None
+    # leaves the lowering byte-identical. Chained after the ledger's
+    # last min sweep (rho_min) when the ledger runs, else after the
+    # shard-metrics gather, keeping one total collective order
+    if cfg.snap is not None:
+        ed = extra_diag or {}
+        diagnostics.update(snapshot_diagnostics(
+            new_state, rho, box, cfg.snap,
+            token=diagnostics.get("rho_min", ed.get("shard_trips")),
         ))
     if dt_limiter is not None:
         diagnostics["dt_limiter"] = dt_limiter
@@ -1241,6 +1261,15 @@ def _integrate_and_finish_blockdt(
             egrav=ed.get("egrav", 0.0), box=box, c=c,
             smoothing=True,
             token=ed.get("shard_trips"),
+        ))
+    # snapshot deposit, conditional like cfg.obs (see
+    # _integrate_and_finish); runs over ALL rows like the ledger — the
+    # frame must show the frozen rows too
+    if cfg.snap is not None:
+        ed = extra_diag or {}
+        diagnostics.update(snapshot_diagnostics(
+            new_state, rho, box, cfg.snap,
+            token=diagnostics.get("rho_min", ed.get("shard_trips")),
         ))
     if dt_limiter is not None:
         diagnostics["dt_limiter"] = dt_limiter

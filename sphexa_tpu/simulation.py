@@ -8,6 +8,7 @@ when particle motion invalidates it — the rare recompile boundary — and
 """
 
 import dataclasses
+import os
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -101,6 +102,7 @@ def make_propagator_config(
     list_slot_margin: float = 1.3,
     sizing_cache=None,
     obs_spec=None,
+    snap_spec=None,
     tuned: object = None,
     workload: Optional[str] = None,
     dt_bins: Optional[int] = None,
@@ -257,6 +259,7 @@ def make_propagator_config(
         const=const, nbr=nbr, curve=curve, block=block, av_clean=av_clean,
         keep_accels=keep_accels, keep_fields=keep_fields, backend=backend,
         list_slot_cap=slot_cap, list_skin_rel=list_skin_rel, obs=obs_spec,
+        snap=snap_spec,
         dt_bins=dt_bins, bin_sync_every=bin_sync_every,
         bin_resort_drift=bin_resort_drift,
     )
@@ -339,6 +342,10 @@ class Simulation:
         telemetry: Optional[Telemetry] = None,
         imbalance_ratio: float = 1.5,
         obs_spec=None,
+        snap_spec=None,
+        snap_every: Optional[int] = None,
+        snap_keep: Optional[int] = None,
+        snap_dir: Optional[str] = None,
         drift_budget: Optional[float] = None,
         science_rows: bool = False,
         tuned: object = None,
@@ -490,6 +497,27 @@ class Simulation:
         # drivers that never drain don't grow an unbounded list
         self._collect_science = bool(science_rows)
         self._science: list = []
+        # live science surface (schema v8, observables/snapshot.py): the
+        # in-graph field-grid deposit rides the diagnostics dict and is
+        # fetched at the SAME check/flush boundaries — zero added host
+        # syncs under deferral (pinned by the no-sync guard). Frames go
+        # to a sidecar ``snapshots/`` ring of .npz files (capped by
+        # snap_keep); (it, path) pairs accumulate for drain_snapshots()
+        # (the --insitu consumer).
+        self._snap_spec = snap_spec
+        self._snap_every = max(1, int(snap_every)) if snap_every else 1
+        self._snap_keep = int(snap_keep) if snap_keep else 0
+        self._snap_dir = snap_dir
+        if snap_spec is not None and snap_dir is None and telemetry is not None:
+            # default the ring next to events.jsonl (the JsonlSink's dir)
+            for sink in getattr(telemetry, "sinks", ()) or ():
+                p = getattr(sink, "path", None)
+                if p:
+                    self._snap_dir = os.path.join(
+                        os.path.dirname(str(p)) or ".", "snapshots")
+                    break
+        self._snap_frames: list = []   # (iteration, path) for drain
+        self._snap_ring: list = []     # paths live in the ring, oldest first
         self.state = state
         self.box = box
         self.const = const
@@ -740,6 +768,7 @@ class Simulation:
             list_slot_margin=self._slot_margin,
             sizing_cache=sizing_cache[:2] if sizing_cache else None,
             obs_spec=self._obs_spec,
+            snap_spec=self._snap_spec,
             dt_bins=self.dt_bins, bin_sync_every=self.bin_sync_every,
             bin_resort_drift=self.bin_resort_drift,
             # table-resolved neighbor-engine knobs (cell_target/run_cap/
@@ -1264,16 +1293,19 @@ class Simulation:
     @staticmethod
     def _scalar_view(diagnostics) -> Dict:
         """Scalars + the tiny (P,) per-shard telemetry arrays
-        (SHARD_DIAG_KEYS) and (B,) bin populations (BLOCKDT_DIAG_KEYS) —
+        (SHARD_DIAG_KEYS), (B,) bin populations (BLOCKDT_DIAG_KEYS) and
+        the (F, G, G)-sized snapshot grids (SNAP_DIAG_KEYS) —
         everything the flush boundary fetches in one batch. Per-particle
         arrays (keep_fields/keep_accels) stay on device."""
         from sphexa_tpu.propagator import (
-            BLOCKDT_DIAG_KEYS, GRAV_SHARD_DIAG_KEYS, SHARD_DIAG_KEYS)
+            BLOCKDT_DIAG_KEYS, GRAV_SHARD_DIAG_KEYS, SHARD_DIAG_KEYS,
+            SNAP_DIAG_KEYS)
 
         return {
             k: v for k, v in diagnostics.items()
             if getattr(v, "ndim", 0) == 0 or k in SHARD_DIAG_KEYS
             or k in BLOCKDT_DIAG_KEYS or k in GRAV_SHARD_DIAG_KEYS
+            or k in SNAP_DIAG_KEYS
         }
 
     @classmethod
@@ -1529,6 +1561,72 @@ class Simulation:
             work=sum(float(d["bdt_work"]) for d in ds),
         )
 
+    def drain_snapshots(self) -> list:
+        """(iteration, npz_path) pairs for snapshot frames written since
+        the last drain, in iteration order — the thin interface the
+        --insitu renderer consumes (host file IO only, no device
+        access). Frames appear only at check/flush boundaries, so under
+        deferral a whole window's due frames land at once."""
+        frames, self._snap_frames = self._snap_frames, []
+        return frames
+
+    def _emit_snapshot(self, fetched, its) -> None:
+        """Schema-v8 live science surface at the fetch boundary: for
+        every due step (``it % snap_every == 0``) write one .npz frame
+        into the ``snapshots/`` ring (grid + meta; capped at snap_keep)
+        and emit one ``snapshot`` event (grid meta + extrema inline, the
+        frame path as the pointer). ``fetched`` holds the
+        already-FETCHED diagnostics — host numpy + file IO only, the
+        deferred-window zero-sync contract is untouched (pinned by
+        tests/test_telemetry.py's snapshot guard)."""
+        if self._snap_spec is None:
+            return
+        spec = self._snap_spec
+        steps = [(it, d) for it, d in zip(its, fetched)
+                 if "snap_grid" in d and it % self._snap_every == 0]
+        if not steps:
+            return
+        tel = self.telemetry
+        # box extents travel with every frame so a jax-free renderer can
+        # label axes; fetched once per boundary (the boundary is already
+        # a sync point)
+        lo = np.asarray(jax.device_get(self.box.lo), np.float64)
+        lengths = np.asarray(jax.device_get(self.box.lengths), np.float64)
+        for it, d in steps:
+            grid = np.asarray(d["snap_grid"])
+            vmin = [float(v) for v in np.asarray(d["snap_min"])]
+            vmax = [float(v) for v in np.asarray(d["snap_max"])]
+            path = None
+            if self._snap_dir:
+                os.makedirs(self._snap_dir, exist_ok=True)
+                path = os.path.join(self._snap_dir,
+                                    f"snap_{int(it):06d}.npz")
+                payload = {
+                    "grid": grid, "it": np.int64(it),
+                    "fields": np.asarray(spec.fields),
+                    "axis": np.int64(spec.axis),
+                    "reduce": np.asarray(spec.reduce),
+                    "volume": np.bool_(spec.volume),
+                    "lo": lo, "lengths": lengths,
+                    "vmin": np.asarray(vmin), "vmax": np.asarray(vmax),
+                }
+                if "snap_pts" in d:
+                    payload["pts"] = np.asarray(d["snap_pts"])
+                np.savez(path, **payload)
+                self._snap_frames.append((int(it), path))
+                self._snap_ring.append(path)
+                while self._snap_keep > 0 \
+                        and len(self._snap_ring) > self._snap_keep:
+                    old = self._snap_ring.pop(0)
+                    try:
+                        os.remove(old)
+                    except OSError:
+                        pass
+            tel.event("snapshot", it=int(it), fields=list(spec.fields),
+                      grid=spec.grid, axis=spec.axis, reduce=spec.reduce,
+                      volume=spec.volume, vmin=vmin, vmax=vmax,
+                      path=path)
+
     @staticmethod
     def _lists_fresh(diagnostics) -> bool:
         """False when the step ran on EXPIRED lists (drift/growth ate
@@ -1628,6 +1726,7 @@ class Simulation:
         self._emit_distributed(diagnostics, steps=1)
         self._emit_science([diagnostics], [self.iteration])
         self._emit_blockdt([diagnostics], [self.iteration])
+        self._emit_snapshot([diagnostics], [self.iteration])
         self._emit_memory("post-compile")
         if self.debug_checks:
             # first triggered checkify predicate of THIS step ("" = all
@@ -1721,6 +1820,7 @@ class Simulation:
                                  self.iteration + 1))
             self._emit_science(fetched, win_its)
             self._emit_blockdt(fetched, win_its)
+            self._emit_snapshot(fetched, win_its)
             self._emit_memory("post-compile")
             self._emit_memory("flush")
             diagnostics = {**pending[-1], **fetched[-1]}

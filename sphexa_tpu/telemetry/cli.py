@@ -8,6 +8,9 @@
     sphexa-telemetry history [inputs...] [--root DIR]
     sphexa-telemetry regress --lock <lock.json> [candidate] [--write]
     sphexa-telemetry tuning <run-dir | TUNING_TABLE.json> [--require K]
+    sphexa-telemetry serve <dir|glob> [--out HTML] [--port N]
+                                      [--refresh S] [--once]
+    sphexa-telemetry fleet <glob> [--format text|json]
 
 ``summary`` reads ``<run-dir>/manifest.json`` + ``events.jsonl`` and
 reports p50/p95/mean step time, retrace/rollback/reconfigure counts and
@@ -64,6 +67,15 @@ no tuning telemetry; on a table file it schema- and registry-validates
 the committed ``TUNING_TABLE.json`` (a stale knob name = exit 1) and
 renders its coverage, with ``--require workload,n,p,backend`` exiting 1
 on a coverage gap.
+
+``serve`` / ``fleet`` are the live science surface (schema v8,
+telemetry/serve.py): a self-contained auto-refreshing HTML dashboard
+(or text table) over one or MANY run dirs — step-time sparklines,
+drift/watchdog badges, per-shard load, dt_bins histograms, crash
+blackboxes in red, and field frames rendered from the ``snapshots/``
+.npz ring the in-graph snapshot deposit writes at the flush boundary
+(observables/snapshot.py). Exit 0 rendered / 1 no runs matched / 2
+every matched run unreadable.
 
 Crash-truncated runs are EXPLAINED, not merely tolerated: when the
 flight recorder (telemetry/flightrec.py) left a ``blackbox.json``,
@@ -1066,6 +1078,26 @@ def build_parser() -> argparse.ArgumentParser:
                     help="workload,n,p,backend — exit 1 when the table "
                          "has no entry covering it (coverage-gap gate)")
     pn.add_argument("--format", choices=("text", "json"), default="text")
+    pv = sub.add_parser(
+        "serve",
+        help="fleet dashboard: self-contained auto-refreshing HTML over "
+             "one run dir or a glob of them (telemetry/serve.py)")
+    pv.add_argument("target", help="run dir, fleet root, or glob")
+    pv.add_argument("--out", default=None,
+                    help="HTML output path [sphexa-dashboard.html]")
+    pv.add_argument("--port", type=int, default=None,
+                    help="serve live via http.server instead of writing "
+                         "a file")
+    pv.add_argument("--refresh", type=float, default=5.0,
+                    help="page auto-refresh / rewrite interval in "
+                         "seconds [5]")
+    pv.add_argument("--once", action="store_true",
+                    help="render one page and exit (the CI shape)")
+    pf = sub.add_parser(
+        "fleet",
+        help="text aggregation table over a glob of run dirs")
+    pf.add_argument("target", help="run dir, fleet root, or glob")
+    pf.add_argument("--format", choices=("text", "json"), default="text")
     return p
 
 
@@ -1195,6 +1227,15 @@ def main(argv=None) -> int:
             print(json.dumps(res, indent=2) if args.format == "json"
                   else render_regress(res))
             return 1 if res["regressed"] else 0
+        if args.cmd == "serve":
+            from sphexa_tpu.telemetry.serve import serve_cmd
+
+            return serve_cmd(args.target, out=args.out, port=args.port,
+                             refresh=args.refresh, once=args.once)
+        if args.cmd == "fleet":
+            from sphexa_tpu.telemetry.serve import fleet_cmd
+
+            return fleet_cmd(args.target, fmt=args.format)
         if args.cmd == "tuning":
             if os.path.isdir(args.target):
                 if args.require:

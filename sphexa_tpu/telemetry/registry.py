@@ -27,14 +27,17 @@ import numpy as np
 #: v5 the autotuning kinds (sweep / tuning), v6 the block-timestep kind
 #: (dt_bins); v7 the optional ``stage`` payload ("sph" | "gravity") on
 #: the exchange / shard_load kinds — the gravity near field's MAC-sized
-#: sparse serve emits its own exchange record next to the SPH one. No
-#: new kinds and no new REQUIRED fields, so v7 readers accept v1-v6
-#: files and v6 readers skip the extra key.
-SCHEMA_VERSION = 7
+#: sparse serve emits its own exchange record next to the SPH one (no
+#: new kinds and no new REQUIRED fields); v8 the live-science-surface
+#: kind (snapshot) — in-graph field-grid frames riding the flush
+#: boundary (observables/snapshot.py), rendered by ``sphexa-telemetry
+#: serve``. v8 only ADDS a kind, so v8 readers accept v1-v7 files
+#: strictly clean and v7 readers count ``snapshot`` under unknown_kinds.
+SCHEMA_VERSION = 8
 
 #: event schema versions this reader understands (older versions only
 #: ever ADD kinds, so the per-kind field table below covers them all)
-SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+SUPPORTED_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 #: every event kind the schema admits, with its required payload fields
 #: (beyond the envelope ``v``/``seq``/``t``/``kind``). The CLI's --strict
@@ -107,6 +110,13 @@ EVENT_KINDS: Dict[str, tuple] = {
     # plus the drift-aware resort decision counters (resorts/keeps) and
     # the worst observed key-drift inversion count (drift_max)
     "dt_bins": ("it", "pop", "updates", "updates_full"),
+    # -- v8: live-science-surface kind (observables/snapshot.py) ----------
+    # one in-graph snapshot frame fetched at the check/flush boundary:
+    # grid meta + per-field extrema inline (``fields``/``grid``/``axis``/
+    # ``reduce``/``vmin``/``vmax``), pixels in the sidecar ``snapshots/``
+    # .npz ring with ``path`` as the pointer (null when no ring dir is
+    # configured) — rendered by ``sphexa-telemetry serve``
+    "snapshot": ("it", "fields", "grid"),
 }
 
 #: first schema version each kind appeared in (an older-versioned event
@@ -116,9 +126,11 @@ _V3_ONLY = frozenset({"physics", "numerics", "drift", "field_health"})
 _V4_ONLY = frozenset({"phase_attr", "crash"})
 _V5_ONLY = frozenset({"sweep", "tuning"})
 _V6_ONLY = frozenset({"dt_bins"})
+_V8_ONLY = frozenset({"snapshot"})
 KIND_SINCE: Dict[str, int] = {
-    k: 6 if k in _V6_ONLY else 5 if k in _V5_ONLY else 4 if k in _V4_ONLY
-    else 3 if k in _V3_ONLY else 2 if k in _V2_ONLY else 1
+    k: 8 if k in _V8_ONLY else 6 if k in _V6_ONLY else 5 if k in _V5_ONLY
+    else 4 if k in _V4_ONLY else 3 if k in _V3_ONLY
+    else 2 if k in _V2_ONLY else 1
     for k in EVENT_KINDS
 }
 

@@ -51,6 +51,7 @@ PHASES = (
     "dt-bins",          # block-timestep bin assignment, active compaction
     "integrate",        # drift/kick, PBC wrap, smoothing-length nudge
     "ledger",           # in-graph conservation/numerics science ledger
+    "snapshot",         # in-graph downsampled field-grid deposit
     "shard-metrics",    # per-shard telemetry pack + gather
 )
 

@@ -82,6 +82,25 @@ def render_field(
     return _colormap(v[::-1])  # image row 0 = top = ymax
 
 
+def render_grid(grid, log_scale: bool = True,
+                upsample: int = 16) -> np.ndarray:
+    """Pre-deposited (G, G) field grid -> color image, same log/clip/
+    colormap treatment as ``render_field``. This is the snapshot-ring
+    consumer path (observables/snapshot.py frames): the deposit already
+    happened in-graph, so rendering is pure host pixel work. Grid row 0
+    is the low-coordinate row; the image flips so row 0 = top."""
+    img = np.asarray(grid, np.float64)
+    if log_scale:
+        img = np.log10(np.abs(img) + 1e-12)
+    finite = img[np.isfinite(img)]
+    lo = np.percentile(finite, 1.0) if finite.size else 0.0
+    hi = np.percentile(finite, 99.9) if finite.size else 1.0
+    v = np.clip((img - lo) / max(hi - lo, 1e-30), 0.0, 1.0)
+    if upsample > 1:
+        v = np.repeat(np.repeat(v, upsample, axis=0), upsample, axis=1)
+    return _colormap(v[::-1])
+
+
 class InsituViz:
     """Per-iteration render hook (the Ascent-adaptor role).
 
@@ -130,6 +149,26 @@ class InsituViz:
             keep = np.abs(z - z0) <= half
             x, y, m = x[keep], y[keep], m[keep]
         img = render_field(x, y, m, extent, self.resolution)
+        path = os.path.join(
+            self.out_dir, f"insitu_{self.mode}_{iteration:06d}.png"
+        )
+        self._writer(path, _png_bytes(img))
+        self.rendered += 1
+        return path
+
+    def execute_grid(self, grid, iteration: int) -> Optional[str]:
+        """Render one frame from a deposited snapshot grid (the ring
+        consumer: sim.drain_snapshots() frames instead of full particle
+        state — host pixel work only, zero device access). Frame naming
+        and the rendered counter match execute(); a multi-field (F, G,
+        G) grid renders its first field."""
+        if iteration % self.every:
+            return None
+        g = np.asarray(grid, np.float64)
+        if g.ndim == 3:
+            g = g[0]
+        upsample = max(1, self.resolution // max(1, g.shape[0]))
+        img = render_grid(g, upsample=upsample)
         path = os.path.join(
             self.out_dir, f"insitu_{self.mode}_{iteration:06d}.png"
         )

@@ -365,11 +365,12 @@ class TestSchemaV6:
 
 
 class TestSchemaV7:
-    def test_v7_is_current(self):
-        assert SCHEMA_VERSION == 7
+    def test_v7_keeps_no_kinds(self):
         # v7 adds the optional staged-exchange payload, no new kinds: no
-        # KIND_SINCE entry may claim 7
-        assert max(KIND_SINCE.values()) == 6
+        # KIND_SINCE entry may claim 7 (v8 added the snapshot kind —
+        # tests/test_serve.py pins the current version)
+        assert SCHEMA_VERSION == 8
+        assert 7 not in KIND_SINCE.values()
 
     def test_v7_staged_exchange_validates(self):
         for stage in ("sph", "gravity"):
